@@ -1,0 +1,103 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on jax/XLA/pallas.
+
+Usage mirrors the reference's `import paddle`:
+
+    import paddle_tpu as paddle
+    paddle.set_device('tpu')
+    x = paddle.randn([4, 8]); y = paddle.matmul(x, x.T)
+
+Architecture: eager ops dispatch tensors through XLA-compiled primitives with
+tape autograd (`paddle_tpu.core`); the performance path compiles whole train
+steps with jax.jit/pjit over a device Mesh (`paddle_tpu.jit`,
+`paddle_tpu.distributed`).
+"""
+
+import jax as _jax
+
+# TPU-first numerics: keep x64 off (f32/bf16 on MXU); reference default dtype
+# is float32 as well.
+_jax.config.update("jax_enable_x64", False)
+
+from paddle_tpu.framework import dtypes as _dtypes
+from paddle_tpu.framework.dtypes import (  # noqa: F401
+    bfloat16, bool_, complex128, complex64, float16, float32, float64,
+    get_default_dtype, int16, int32, int64, int8, set_default_dtype, uint8,
+)
+
+bool = bool_  # paddle.bool
+
+from paddle_tpu.framework.device import (  # noqa: F401
+    device_count, get_device, is_compiled_with_cuda, is_compiled_with_rocm,
+    is_compiled_with_xpu, is_compiled_with_custom_device, set_device,
+    get_all_custom_device_type,
+)
+from paddle_tpu.framework.flags import get_flags, set_flags  # noqa: F401
+from paddle_tpu.framework.random import seed, get_rng_state, set_rng_state  # noqa: F401
+from paddle_tpu.framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+
+from paddle_tpu.core.tensor import (  # noqa: F401
+    Tensor, to_tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+)
+from paddle_tpu.core.backward import grad  # noqa: F401
+
+from paddle_tpu.ops.creation import *  # noqa: F401,F403
+from paddle_tpu.ops.math import *  # noqa: F401,F403
+from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
+from paddle_tpu.ops.linalg import *  # noqa: F401,F403
+from paddle_tpu.ops.logic import *  # noqa: F401,F403
+from paddle_tpu.ops.search import *  # noqa: F401,F403
+
+from paddle_tpu.core import ops_patch as _ops_patch
+
+_ops_patch.install()
+
+from paddle_tpu import nn  # noqa: F401,E402
+from paddle_tpu import optimizer  # noqa: F401,E402
+from paddle_tpu import io  # noqa: F401,E402
+from paddle_tpu import metric  # noqa: F401,E402
+from paddle_tpu import amp  # noqa: F401,E402
+from paddle_tpu import autograd  # noqa: F401,E402
+from paddle_tpu import framework  # noqa: F401,E402
+from paddle_tpu import jit  # noqa: F401,E402
+from paddle_tpu import vision  # noqa: F401,E402
+from paddle_tpu import hapi  # noqa: F401,E402
+from paddle_tpu.hapi.model import Model  # noqa: F401,E402
+from paddle_tpu.framework.io import save, load  # noqa: F401,E402
+from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401,E402
+
+# paddle.DataParallel / paddle.distributed are imported lazily to avoid
+# pulling the whole distributed stack at import time
+def __getattr__(name):
+    if name == "distributed":
+        import paddle_tpu.distributed as dist
+
+        return dist
+    if name == "DataParallel":
+        from paddle_tpu.distributed.parallel import DataParallel
+
+        return DataParallel
+    if name == "inference":
+        import paddle_tpu.inference as inference
+
+        return inference
+    if name == "static":
+        import paddle_tpu.static as static
+
+        return static
+    if name == "profiler":
+        import paddle_tpu.profiler as profiler
+
+        return profiler
+    if name == "incubate":
+        import paddle_tpu.incubate as incubate
+
+        return incubate
+    if name == "sparse":
+        import paddle_tpu.sparse as sparse
+
+        return sparse
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,118 @@
+"""paddle.autograd (reference: `python/paddle/autograd/`): backward, PyLayer, hooks."""
+
+from paddle_tpu.core.backward import run_backward, grad  # noqa: F401
+from paddle_tpu.core.tensor import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from paddle_tpu.core.tensor import Tensor, GradNode
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph)
+
+
+class PyLayerContext:
+    """reference: `python/paddle/autograd/py_layer.py` PyLayerContext."""
+
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        pass
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = value
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """User-defined autograd op (reference: `python/paddle/autograd/py_layer.py`).
+
+    forward/backward are written over eager Tensors; the recorded node calls
+    the user backward with the saved context. This is the substrate for
+    recompute and the TP comm layers, exactly as in the reference.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from paddle_tpu.core.tensor import is_grad_enabled
+
+        ctx = PyLayerContext()
+        with_no_grad_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in with_no_grad_inputs)
+
+        from paddle_tpu.core import tensor as _tmod
+
+        prev = _tmod.is_grad_enabled()
+        _tmod.set_grad_enabled(False)
+        try:
+            outputs = cls.forward(ctx, *args, **kwargs)
+        finally:
+            _tmod.set_grad_enabled(prev)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+
+        if needs_grad:
+            tensor_inputs = with_no_grad_inputs
+
+            class _PyNode(GradNode):
+                __slots__ = ()
+
+            def vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                ct_tensors = [Tensor(c) for c in cts]
+                prev2 = _tmod.is_grad_enabled()
+                _tmod.set_grad_enabled(False)
+                try:
+                    grads = cls.backward(ctx, *ct_tensors) if len(ct_tensors) > 1 else cls.backward(ctx, ct_tensors[0])
+                finally:
+                    _tmod.set_grad_enabled(prev2)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                return tuple(g._data if isinstance(g, Tensor) else g for g in grads)
+
+            node = GradNode(vjp_fn, tensor_inputs, [o._data for o in outs],
+                            name=cls.__name__)
+            for i, o in enumerate(outs):
+                o._node = node
+                o._out_idx = i
+                o.stop_gradient = False
+        return outputs
+
+
+class saved_tensors_hooks:
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *args):
+        return False

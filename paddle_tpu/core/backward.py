@@ -1,0 +1,205 @@
+"""Backward engine: dependency-counted queue traversal over GradNodes.
+
+Same algorithm as the reference engine (`paddle/fluid/eager/backward.cc:106`
+RunBackward: seed queue with loss node, count in-degrees, pop ready nodes,
+run grad kernel, accumulate into successors). Each node's grad "kernel" here
+is a jax.vjp closure executing XLA-compiled programs.
+"""
+
+from __future__ import annotations
+
+import weakref
+from collections import deque
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, GradNode, _is_float_dtype
+
+_tensor_hooks = weakref.WeakKeyDictionary()
+
+
+_hook_counter = [0]
+
+
+class RemovableHandle:
+    def __init__(self, tensor, hook_id):
+        self._ref = weakref.ref(tensor)
+        self._hook_id = hook_id
+
+    def remove(self):
+        t = self._ref()
+        if t is not None and t in _tensor_hooks:
+            _tensor_hooks[t].pop(self._hook_id, None)
+
+
+def register_tensor_hook(tensor, hook):
+    hooks = _tensor_hooks.setdefault(tensor, {})
+    _hook_counter[0] += 1
+    hooks[_hook_counter[0]] = hook
+    return RemovableHandle(tensor, _hook_counter[0])
+
+
+def _accumulate(slot, value):
+    return value if slot is None else slot + value
+
+
+def _is_float0(arr):
+    import jax.dtypes
+
+    return hasattr(arr, "dtype") and arr.dtype == jax.dtypes.float0
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph=False, leaf_filter=None):
+    """Seed cotangents on `tensors` and propagate to all reachable leaves.
+
+    leaf_filter: optional set of tensor ids; when given, gradients land only
+    on those leaves (used by paddle.grad so it does not pollute .grad of
+    unrelated parameters)."""
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # seed
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._node is None:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "got shape {}".format(t.shape)
+                )
+            g = jnp.ones_like(t._data)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        roots.append((t, g))
+
+    # collect reachable node graph + consumer counts (in-degree for Kahn)
+    indegree = {}
+    visited = set()
+    stack = [t._node for t, _ in roots if t._node is not None]
+    for n in stack:
+        indegree.setdefault(n, 0)
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        for inp in node.inputs:
+            pnode = inp._node
+            if pnode is not None:
+                indegree[pnode] = indegree.get(pnode, 0) + 1
+                if id(pnode) not in visited:
+                    stack.append(pnode)
+
+    # seed pending cotangents
+    ready = deque()
+    seeded = set()
+    for t, g in roots:
+        node = t._node
+        if node is None:
+            if leaf_filter is None or id(t) in leaf_filter:
+                _land_leaf_grad(t, g)
+            continue
+        node.ensure_pending()
+        node.pending[t._out_idx] = _accumulate(node.pending[t._out_idx], g)
+        if id(node) not in seeded and indegree.get(node, 0) == 0:
+            ready.append(node)
+            seeded.add(id(node))
+
+    # Kahn traversal
+    processed = set()
+    while ready:
+        node = ready.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+
+        node.ensure_pending()
+        cotangents = tuple(
+            p if p is not None else jnp.zeros(s, d)
+            for p, s, d in zip(node.pending, node.out_shapes, node.out_dtypes)
+        )
+        if len(cotangents) == 1:
+            in_grads = node.vjp_fn(cotangents[0])
+        else:
+            in_grads = node.vjp_fn(cotangents)
+
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None or _is_float0(g) or not _is_float_dtype(inp.dtype):
+                pnode = inp._node
+                if pnode is not None:
+                    _dec_and_maybe_ready(indegree, pnode, ready)
+                continue
+            pnode = inp._node
+            if pnode is not None:
+                pnode.ensure_pending()
+                pnode.pending[inp._out_idx] = _accumulate(pnode.pending[inp._out_idx], g)
+                _dec_and_maybe_ready(indegree, pnode, ready)
+            elif not inp.stop_gradient:
+                if leaf_filter is None or id(inp) in leaf_filter:
+                    _land_leaf_grad(inp, g)
+
+        if not retain_graph:
+            node.release()
+        else:
+            node.pending = None
+
+    if not retain_graph:
+        for t, _ in roots:
+            t._node = None
+
+
+def _dec_and_maybe_ready(indegree, node, ready):
+    indegree[node] = indegree.get(node, 1) - 1
+    if indegree[node] <= 0:
+        ready.append(node)
+
+
+def _land_leaf_grad(tensor, g):
+    for hook in list(_tensor_hooks.get(tensor, {}).values()):
+        out = hook(Tensor(g))
+        if out is not None:
+            g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+    if tensor.grad is None:
+        tensor.grad = Tensor(g)
+    else:
+        tensor.grad._data = tensor.grad._data + g
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad equivalent (reference: `paddle/fluid/eager/general_grad.h`).
+
+    Implemented by running the tape backward while temporarily capturing
+    leaf grads of `inputs` instead of writing .grad.
+    """
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is not None and not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    saved = [(t.grad, t.stop_gradient) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t.stop_gradient = False
+    try:
+        run_backward(list(outputs), grad_outputs, retain_graph=bool(retain_graph),
+                     leaf_filter={id(t) for t in inputs})
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError("one of the input tensors received no gradient; "
+                                       "pass allow_unused=True to permit this")
+                results.append(None)
+            else:
+                results.append(t.grad)
+    finally:
+        for t, (g, sg) in zip(inputs, saved):
+            t.grad = g
+            t.stop_gradient = sg
+    return results

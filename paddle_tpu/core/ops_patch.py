@@ -1,0 +1,130 @@
+"""Patch operators and tensor-method sugar onto Tensor.
+
+Reference analogue: math-op patching in `paddle/fluid/pybind/eager_math_op_patch.cc`
+and `python/paddle/base/dygraph/math_op_patch.py`.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply
+from paddle_tpu.ops import math as _m
+from paddle_tpu.ops import logic as _l
+from paddle_tpu.ops import linalg as _la
+from paddle_tpu.ops import manipulation as _mp
+from paddle_tpu.ops import search as _s
+
+
+def _coerce_index(item):
+    """Convert Tensor indices to arrays inside an index tuple."""
+    if isinstance(item, tuple):
+        return tuple(_coerce_index(i) for i in item)
+    if isinstance(item, Tensor):
+        return item._data
+    if isinstance(item, (list, np.ndarray)):
+        return jnp.asarray(np.asarray(item))
+    return item
+
+
+def _getitem(self, item):
+    idx = _coerce_index(item)
+    return apply(lambda a: a[idx], self, _name="getitem")
+
+
+def _setitem(self, item, value):
+    idx = _coerce_index(item)
+    if isinstance(value, Tensor):
+        out = apply(lambda a, v: a.at[idx].set(v.astype(a.dtype)), self, value, _name="setitem")
+    else:
+        v = jnp.asarray(np.asarray(value))
+        out = apply(lambda a: a.at[idx].set(v.astype(a.dtype)), self, _name="setitem")
+    self._data, self._node, self._out_idx = out._data, out._node, out._out_idx
+    if not out.stop_gradient:
+        self.stop_gradient = False
+
+
+def install():
+    T = Tensor
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    T.__add__ = lambda s, o: _m.add(s, o)
+    T.__radd__ = lambda s, o: _m.add(o, s)
+    T.__sub__ = lambda s, o: _m.subtract(s, o)
+    T.__rsub__ = lambda s, o: _m.subtract(o, s)
+    T.__mul__ = lambda s, o: _m.multiply(s, o)
+    T.__rmul__ = lambda s, o: _m.multiply(o, s)
+    T.__truediv__ = lambda s, o: _m.divide(s, o)
+    T.__rtruediv__ = lambda s, o: _m.divide(o, s)
+    T.__floordiv__ = lambda s, o: _m.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: _m.floor_divide(o, s)
+    T.__mod__ = lambda s, o: _m.mod(s, o)
+    T.__rmod__ = lambda s, o: _m.mod(o, s)
+    T.__pow__ = lambda s, o: _m.pow(s, o)
+    T.__rpow__ = lambda s, o: _m.pow(o, s)
+    T.__neg__ = lambda s: _m.neg(s)
+    T.__abs__ = lambda s: _m.abs(s)
+    T.__matmul__ = lambda s, o: _la.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: _la.matmul(o, s)
+    T.__invert__ = lambda s: _l.logical_not(s) if s.dtype == np.bool_ else _m.bitwise_not(s)
+    T.__and__ = lambda s, o: _l.logical_and(s, o) if s.dtype == np.bool_ else _m.bitwise_and(s, o)
+    T.__or__ = lambda s, o: _l.logical_or(s, o) if s.dtype == np.bool_ else _m.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: _l.logical_xor(s, o) if s.dtype == np.bool_ else _m.bitwise_xor(s, o)
+    T.__eq__ = lambda s, o: _l.equal(s, o)
+    T.__ne__ = lambda s, o: _l.not_equal(s, o)
+    T.__lt__ = lambda s, o: _l.less_than(s, o)
+    T.__le__ = lambda s, o: _l.less_equal(s, o)
+    T.__gt__ = lambda s, o: _l.greater_than(s, o)
+    T.__ge__ = lambda s, o: _l.greater_equal(s, o)
+
+    # tensor methods mirroring the paddle.Tensor method surface
+    method_table = {
+        "add": _m.add, "subtract": _m.subtract, "multiply": _m.multiply,
+        "divide": _m.divide, "floor_divide": _m.floor_divide, "mod": _m.mod,
+        "remainder": _m.mod, "pow": _m.pow, "maximum": _m.maximum, "minimum": _m.minimum,
+        "abs": _m.abs, "exp": _m.exp, "log": _m.log, "log2": _m.log2, "log10": _m.log10,
+        "log1p": _m.log1p, "sqrt": _m.sqrt, "rsqrt": _m.rsqrt, "square": _m.square,
+        "sin": _m.sin, "cos": _m.cos, "tan": _m.tan, "tanh": _m.tanh,
+        "sigmoid": _m.sigmoid, "erf": _m.erf, "floor": _m.floor, "ceil": _m.ceil,
+        "round": _m.round, "trunc": _m.trunc, "sign": _m.sign, "neg": _m.neg,
+        "reciprocal": _m.reciprocal, "clip": _m.clip, "scale": _m.scale, "lerp": _m.lerp,
+        "sum": _m.sum, "mean": _m.mean, "max": _m.max, "min": _m.min, "prod": _m.prod,
+        "all": _m.all, "any": _m.any, "logsumexp": _m.logsumexp, "std": _m.std,
+        "var": _m.var, "cumsum": _m.cumsum, "cumprod": _m.cumprod, "median": _m.median,
+        "trace": _m.trace, "isnan": _m.isnan, "isinf": _m.isinf, "isfinite": _m.isfinite,
+        "nan_to_num": _m.nan_to_num,
+        "matmul": _la.matmul, "mm": _la.mm, "bmm": _la.bmm, "dot": _la.dot,
+        "norm": _la.norm, "dist": _la.dist, "inverse": _la.inverse, "cholesky": _la.cholesky,
+        "reshape": _mp.reshape, "reshape_": _mp.reshape_, "transpose": _mp.transpose,
+        "squeeze": _mp.squeeze, "squeeze_": _mp.squeeze_, "unsqueeze": _mp.unsqueeze,
+        "unsqueeze_": _mp.unsqueeze_, "flatten": _mp.flatten, "expand": _mp.expand,
+        "expand_as": _mp.expand_as, "broadcast_to": _mp.broadcast_to, "tile": _mp.tile,
+        "flip": _mp.flip, "roll": _mp.roll, "gather": _mp.gather, "gather_nd": _mp.gather_nd,
+        "scatter": _mp.scatter, "scatter_nd_add": _mp.scatter_nd_add,
+        "index_select": _mp.index_select, "index_add": _mp.index_add,
+        "masked_select": _mp.masked_select, "masked_fill": _mp.masked_fill,
+        "take_along_axis": _mp.take_along_axis, "put_along_axis": _mp.put_along_axis,
+        "split": _mp.split, "chunk": _mp.chunk, "unbind": _mp.unbind, "concat": None,
+        "tensordot": _mp.tensordot, "repeat_interleave": _mp.repeat_interleave,
+        "tril": None, "triu": None, "numel_t": None,
+        "argmax": _s.argmax, "argmin": _s.argmin, "argsort": _s.argsort, "sort": _s.sort,
+        "topk": _s.topk, "nonzero": _s.nonzero, "unique": _mp.unique,
+        "equal": _l.equal, "not_equal": _l.not_equal, "greater_than": _l.greater_than,
+        "greater_equal": _l.greater_equal, "less_than": _l.less_than,
+        "less_equal": _l.less_equal, "logical_and": _l.logical_and,
+        "logical_or": _l.logical_or, "logical_not": _l.logical_not,
+        "logical_xor": _l.logical_xor, "allclose": _l.allclose, "isclose": _l.isclose,
+        "equal_all": _l.equal_all, "bitwise_and": _m.bitwise_and,
+        "bitwise_or": _m.bitwise_or, "bitwise_xor": _m.bitwise_xor,
+        "bitwise_not": _m.bitwise_not,
+    }
+    from paddle_tpu.ops import creation as _c
+
+    method_table["tril"] = _c.tril
+    method_table["triu"] = _c.triu
+    del method_table["concat"]
+    del method_table["numel_t"]
+
+    for name, fn in method_table.items():
+        if fn is not None and not hasattr(T, name):
+            setattr(T, name, fn)

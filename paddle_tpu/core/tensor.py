@@ -1,0 +1,422 @@
+"""Eager Tensor with tape-based autograd over jax.Arrays.
+
+Design (TPU-native rethink of the reference eager stack):
+  - reference: `paddle/phi/core/dense_tensor.h:37` (DenseTensor) +
+    `paddle/fluid/eager/grad_node_info.h:197` (GradNodeBase) +
+    `paddle/fluid/eager/backward.cc:106` (RunBackward queue engine).
+  - here: a Tensor wraps an immutable `jax.Array`; every differentiable op
+    runs through `jax.vjp`, whose residual closure *is* the grad node. The
+    backward engine is the same dependency-counted queue traversal as the
+    reference, but each node's "kernel" is an XLA-compiled vjp instead of a
+    hand-written CUDA grad kernel.
+
+No data-dependent Python control flow leaks into jit'd regions: eager ops
+execute op-by-op (XLA-compiled per primitive, cached by shape); the fast path
+is the compiled trainer in `paddle_tpu.jit` / `paddle_tpu.hapi`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import deque
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Tensor",
+    "to_tensor",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "apply",
+    "apply_multi",
+]
+
+# --------------------------------------------------------------------------
+# global autograd mode
+# --------------------------------------------------------------------------
+
+_state = threading.local()
+
+
+def is_grad_enabled():
+    return getattr(_state, "grad_enabled", True)
+
+
+def set_grad_enabled(mode):
+    _state.grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = is_grad_enabled()
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = is_grad_enabled()
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+# --------------------------------------------------------------------------
+# grad node
+# --------------------------------------------------------------------------
+
+
+class GradNode:
+    """One recorded differentiable op.
+
+    Holds the `jax.vjp` residual closure and the input Tensors. Mirrors the
+    role of the generated GradNode classes in the reference
+    (`paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:1186`),
+    except the backward rule is derived automatically by JAX.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_shapes", "out_dtypes", "name", "pending", "_n_out")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list[Tensor]
+        self.out_shapes = [a.shape for a in out_avals]
+        self.out_dtypes = [a.dtype for a in out_avals]
+        self.name = name
+        self._n_out = len(out_avals)
+        self.pending = None  # accumulated output cotangents during backward
+
+    def ensure_pending(self):
+        if self.pending is None:
+            self.pending = [None] * self._n_out
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = None
+        self.pending = None
+
+
+def _is_float_dtype(dt):
+    return jnp.issubdtype(np.dtype(dt), np.floating) or jnp.issubdtype(
+        np.dtype(dt), np.complexfloating
+    )
+
+
+# --------------------------------------------------------------------------
+# Tensor
+# --------------------------------------------------------------------------
+
+
+class Tensor:
+    """A paddle-like eager tensor backed by a jax.Array."""
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx", "name", "persistable", "__weakref__")
+
+    def __init__(self, data, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self.name = name
+        self.persistable = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def place(self):
+        devs = getattr(self._data, "devices", None)
+        if devs is None:
+            return None
+        ds = self._data.devices()
+        return next(iter(ds)) if ds else None
+
+    @property
+    def T(self):
+        from paddle_tpu.ops import manipulation
+
+        return manipulation.transpose(
+            self, list(range(self.ndim))[::-1]
+        )
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return int(self._data.size)
+
+    def element_size(self):
+        return self._data.dtype.itemsize
+
+    # -- conversions --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dtype):
+        from paddle_tpu.framework import dtypes
+
+        dt = dtypes.convert_dtype(dtype)
+        return apply(lambda x: x.astype(dt), self, _name="cast")
+
+    cast = astype
+
+    def clone(self):
+        return apply(lambda x: x + jnp.zeros((), x.dtype), self, _name="clone")
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]), self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        # accepts dtype or device strings; best-effort paddle semantics
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu"):
+                from paddle_tpu.framework import device as device_mod
+
+                return Tensor(
+                    jax.device_put(self._data, device_mod._resolve_device(a)),
+                    self.stop_gradient,
+                )
+            else:
+                return self.astype(a)
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def contiguous(self):
+        return self
+
+    def is_contiguous(self):
+        return True
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from paddle_tpu.core.backward import run_backward
+
+        run_backward([self], [grad_tensor], retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def register_hook(self, hook):
+        # grad hooks live in the backward engine's weak table
+        from paddle_tpu.core.backward import register_tensor_hook
+
+        return register_tensor_hook(self, hook)
+
+    # -- in-place helpers (optimizer path, runs under no_grad) -------------
+    def copy_(self, other, *args):
+        self._data = other._data if isinstance(other, Tensor) else jnp.asarray(other)
+        return self
+
+    def set_value(self, value):
+        self._data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        return self
+
+    def add_(self, y):
+        data = y._data if isinstance(y, Tensor) else y
+        self._data = self._data + data
+        return self
+
+    def subtract_(self, y):
+        data = y._data if isinstance(y, Tensor) else y
+        self._data = self._data - data
+        return self
+
+    def multiply_(self, y):
+        data = y._data if isinstance(y, Tensor) else y
+        self._data = self._data * data
+        return self
+
+    def scale_(self, scale=1.0, bias=0.0):
+        self._data = self._data * scale + bias
+        return self
+
+    def clip_(self, min=None, max=None):
+        self._data = jnp.clip(self._data, min, max)
+        return self
+
+    # -- python protocol ----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self._data.dtype}{grad_info},\n"
+            f"       {np.array2string(self.numpy(), prefix='       ')})"
+        )
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # math dunders are patched in paddle_tpu/core/ops_patch.py
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+
+# --------------------------------------------------------------------------
+# op application (the dispatch waist — analogue of
+# `paddle/phi/core/kernel_factory.cc:267` SelectKernelOrThrowError, except
+# selection is "one traced+compiled XLA program per (op, shapes, dtypes)")
+# --------------------------------------------------------------------------
+
+
+def _as_data(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def apply(fn, *tensors, _name="op", _nout=None):
+    """Run `fn(*arrays) -> array | tuple(arrays)` over Tensor args, recording
+    a grad node if grad is enabled and any input requires grad.
+
+    AMP hook: when an auto_cast scope is active (analogue of the reference's
+    AMP logic inside generated ad_funcs, `eager_gen.py:2003-2028`), float32
+    inputs to white-list ops are cast to the amp dtype before dispatch."""
+    datas = [t._data for t in tensors]
+
+    from paddle_tpu import amp as _amp
+
+    st = _amp.amp_state()
+    if st is not None and _name in st["white"]:
+        amp_dt = st["dtype"]
+        datas = [d.astype(amp_dt) if d.dtype == jnp.float32 else d for d in datas]
+    needs_grad = is_grad_enabled() and any(
+        (not t.stop_gradient) and _is_float_dtype(t.dtype) for t in tensors
+    )
+    if needs_grad:
+        out, vjp_fn = jax.vjp(fn, *datas)
+    else:
+        out = fn(*datas)
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    result = [Tensor(o, stop_gradient=not needs_grad) for o in outs]
+
+    if needs_grad:
+        node = GradNode(vjp_fn, list(tensors), outs, name=_name)
+        for i, r in enumerate(result):
+            r._node = node
+            r._out_idx = i
+    return result if multi else result[0]
+
+
+def apply_multi(fn, tensor_list, *tensors, _name="op"):
+    """Like `apply` but the first argument is a list of Tensors (concat/stack)."""
+    n = len(tensor_list)
+
+    def wrapped(*datas):
+        return fn(list(datas[:n]), *datas[n:])
+
+    return apply(wrapped, *tensor_list, *tensors, _name=_name)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor (reference: python/paddle/tensor/creation.py)."""
+    from paddle_tpu.framework import dtypes
+
+    if isinstance(data, Tensor):
+        out = data.astype(dtype) if dtype is not None else Tensor(data._data)
+        out.stop_gradient = stop_gradient
+        return out
+    if isinstance(data, (list, tuple)) and any(isinstance(x, Tensor) for x in data):
+        data = [x.numpy() if isinstance(x, Tensor) else x for x in data]
+    arr = np.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(dtypes.convert_dtype(dtype))
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    t = Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+    return t

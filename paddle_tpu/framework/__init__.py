@@ -1,0 +1,15 @@
+from paddle_tpu.framework import dtypes, device, flags, random  # noqa: F401
+from paddle_tpu.framework.dtypes import get_default_dtype, set_default_dtype  # noqa: F401
+from paddle_tpu.framework.random import seed  # noqa: F401
+
+
+def in_dynamic_mode():
+    return True
+
+
+def in_pir_mode():
+    return False
+
+
+def use_pir_api():
+    return False

@@ -1,0 +1,105 @@
+"""Device API (reference: `python/paddle/device/__init__.py:284` set_device).
+
+`paddle.set_device('tpu')` maps device strings onto jax devices and sets the
+jax default device, which every subsequently created buffer lands on.
+"""
+
+import jax
+
+_CANON = {"gpu": "tpu", "cuda": "tpu", "xpu": "tpu"}
+_current = None
+
+
+def _platform_of(name):
+    name = name.split(":")[0].lower()
+    name = _CANON.get(name, name)
+    return name
+
+
+def _resolve_device(name):
+    plat = _platform_of(name)
+    idx = int(name.split(":")[1]) if ":" in name else 0
+    try:
+        devs = jax.devices(plat)
+    except RuntimeError:
+        # 'tpu' requested but running under another accelerator platform
+        # (e.g. the axon tunnel) — fall back to the default backend.
+        devs = jax.devices()
+    if plat == "cpu":
+        devs = jax.devices("cpu")
+    return devs[min(idx, len(devs) - 1)]
+
+
+def set_device(device):
+    global _current
+    dev = _resolve_device(device)
+    jax.config.update("jax_default_device", dev)
+    _current = device if ":" in device else f"{_platform_of(device)}:0"
+    return dev
+
+
+def get_device():
+    if _current is not None:
+        return _current
+    d = jax.devices()[0]
+    plat = d.platform if d.platform != "cpu" else "cpu"
+    return f"{plat}:{d.id}"
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return True
+
+
+def get_all_custom_device_type():
+    return ["tpu"]
+
+
+def device_count():
+    return jax.device_count()
+
+
+def synchronize(device=None):
+    # XLA dispatch is async; block on all live arrays via a trivial barrier
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+
+class Event:
+    """Minimal stream event facade (XLA manages streams internally)."""
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+
+class Stream:
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+
+def current_stream(device=None):
+    return Stream()

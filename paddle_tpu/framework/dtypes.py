@@ -1,0 +1,80 @@
+"""Dtype registry (reference: paddle DataType enum, `paddle/phi/common/data_type.h`).
+
+We use numpy/jax dtypes directly; this module provides paddle-style names and
+string conversion.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "fp16": float16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+
+def convert_dtype(dtype):
+    """Accept strings, numpy dtypes, jnp scalar types, paddle-style names."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "")
+        if key in _ALIASES:
+            return _ALIASES[key]
+        return np.dtype(key)
+    if dtype is jnp.bfloat16 or getattr(dtype, "name", "") == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(dtype)
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return str(np.dtype(_default_dtype)) if _default_dtype != jnp.bfloat16 else "bfloat16"
+
+
+def is_floating_point(dtype):
+    dt = convert_dtype(dtype)
+    if dt is jnp.bfloat16:
+        return True
+    return np.issubdtype(dt, np.floating)
+
+
+def is_integer(dtype):
+    dt = convert_dtype(dtype)
+    if dt is jnp.bfloat16:
+        return False
+    return np.issubdtype(dt, np.integer)

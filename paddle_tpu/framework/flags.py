@@ -1,0 +1,53 @@
+"""Global flag registry (reference: `paddle/common/flags.cc`, 184 exported
+flags; surfaced via paddle.get_flags/set_flags and FLAGS_* env import at
+bootstrap `python/paddle/base/__init__.py:167-186`)."""
+
+import os
+
+_flags = {}
+
+
+def define_flag(name, default, help_str=""):
+    _flags[name] = default
+
+
+# the subset of reference flags that are meaningful on TPU/XLA
+define_flag("FLAGS_check_nan_inf", False, "check every op output for nan/inf")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "host staging allocator strategy")
+define_flag("FLAGS_benchmark", False, "force device sync per op")
+define_flag("FLAGS_use_bf16_matmul", True, "prefer bf16 matmul on MXU")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "gc threshold (no-op: XLA ref-counts)")
+define_flag("FLAGS_cudnn_deterministic", False, "deterministic ops")
+define_flag("FLAGS_embedding_deterministic", 0, "deterministic embedding grad")
+define_flag("FLAGS_low_precision_op_list", 0, "amp op list logging")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "compat no-op")
+
+
+def _bootstrap_from_env():
+    """Import FLAGS_* environment variables, as the reference does at
+    `python/paddle/base/__init__.py:167-186`."""
+    for k, v in os.environ.items():
+        if k.startswith("FLAGS_"):
+            cur = _flags.get(k)
+            if isinstance(cur, bool):
+                _flags[k] = v.lower() in ("1", "true", "yes")
+            elif isinstance(cur, int):
+                _flags[k] = int(v)
+            elif isinstance(cur, float):
+                _flags[k] = float(v)
+            else:
+                _flags[k] = v
+
+
+def set_flags(flags_dict):
+    for k, v in flags_dict.items():
+        _flags[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _flags.get(k) for k in flags}
+
+
+_bootstrap_from_env()

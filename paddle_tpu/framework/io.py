@@ -1,0 +1,49 @@
+"""paddle.save / paddle.load (reference: `python/paddle/framework/io.py:773`).
+
+State dicts pickle as numpy arrays — portable across hosts and readable
+without jax. Tensors reload onto the current default device lazily.
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return ("__tensor__", obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_saveable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_saveable(obj, return_numpy=False):
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__tensor__":
+        return obj[1] if return_numpy else Tensor(np.asarray(obj[1]))
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_saveable(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_saveable(obj, return_numpy=configs.get("return_numpy", False))

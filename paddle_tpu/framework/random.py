@@ -1,0 +1,65 @@
+"""Global RNG (reference: `paddle/phi/core/generator.h` + `paddle.seed`).
+
+JAX uses functional PRNG keys; we keep a global generator that splits a fresh
+subkey per call, so eager ops behave statefully like the reference while each
+underlying kernel stays functional/compile-friendly.
+"""
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_key = jax.random.PRNGKey(0)
+_seed_value = 0
+
+
+def seed(s):
+    global _key, _seed_value
+    with _lock:
+        _seed_value = int(s)
+        _key = jax.random.PRNGKey(_seed_value)
+    return _seed_value
+
+
+def get_rng_state():
+    return _key
+
+
+def set_rng_state(state):
+    global _key
+    with _lock:
+        _key = state
+
+
+_trace_key_stack = []
+
+
+def push_trace_key(key):
+    """Enter functional-RNG mode (used by paddle_tpu.jit): subsequent
+    next_key() calls split from this traced key instead of the global state,
+    keeping compiled programs pure."""
+    _trace_key_stack.append(key)
+
+
+def pop_trace_key():
+    _trace_key_stack.pop()
+
+
+def next_key():
+    global _key
+    if _trace_key_stack:
+        k1, k2 = jax.random.split(_trace_key_stack[-1])
+        _trace_key_stack[-1] = k1
+        return k2
+    with _lock:
+        _key, sub = jax.random.split(_key)
+    return sub
+
+
+def get_cuda_rng_state():
+    return [_key]
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state[0] if isinstance(state, (list, tuple)) else state)

@@ -1,0 +1,9 @@
+from paddle_tpu.hapi.model import Model, InputSpec  # noqa: F401
+from paddle_tpu.hapi import callbacks  # noqa: F401
+from paddle_tpu.hapi.callbacks import Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping  # noqa: F401
+
+
+def summary(net, input_size=None, dtypes=None):
+    n_params = sum(p.size for p in net.parameters())
+    print(f"Total params: {n_params}")
+    return {"total_params": n_params}

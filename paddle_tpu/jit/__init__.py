@@ -1,0 +1,231 @@
+"""paddle.jit: dynamic-to-static (reference: `python/paddle/jit/`,
+`jit/sot/translate.py:37`).
+
+TPU-native design: instead of AST transforms / bytecode capture building a
+ProgramDesc, we *functionalize* the Layer — swap its parameter/buffer storage
+for JAX tracers, run the ordinary eager forward (every paddle_tpu op is a
+jnp call on `Tensor._data`, hence traceable), and let jax.jit compile the
+whole step into one XLA program. This collapses the reference's
+dy2static+PIR+executor pipeline (`pir_interpreter.cc:1492`) into a single
+trace+compile, which is exactly the XLA execution model.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, no_grad
+from paddle_tpu.framework import random as _rng
+from paddle_tpu.nn.layer.layers import Layer
+
+__all__ = ["to_static", "functionalize", "save", "load", "not_to_static", "TracedLayer"]
+
+
+class _SwappedState:
+    """Swap param/buffer arrays for tracers and restore afterwards."""
+
+    def __init__(self, layer):
+        self.layer = layer
+        self.params = dict(layer.named_parameters())
+        self.buffers = dict(layer.named_buffers())
+
+    def run(self, param_datas, buffer_datas, fn_args, fn_kwargs, forward):
+        saved_p = {k: p._data for k, p in self.params.items()}
+        saved_b = {k: b._data for k, b in self.buffers.items()}
+        saved_sg = {k: p.stop_gradient for k, p in self.params.items()}
+        try:
+            for k, p in self.params.items():
+                p._data = param_datas[k]
+                p.stop_gradient = True  # tape off inside trace; jax.grad differentiates
+            for k, b in self.buffers.items():
+                if k in buffer_datas:
+                    b._data = buffer_datas[k]
+            with no_grad():
+                out = forward(*fn_args, **fn_kwargs)
+            new_buffers = {k: b._data for k, b in self.buffers.items()}
+            return out, new_buffers
+        finally:
+            for k, p in self.params.items():
+                p._data = saved_p[k]
+                p.stop_gradient = saved_sg[k]
+            for k, b in self.buffers.items():
+                b._data = saved_b[k]
+
+
+def _tree_to_data(x):
+    return jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t, x,
+                        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _tree_to_tensor(x):
+    return jax.tree.map(lambda a: Tensor(a) if isinstance(a, jax.Array) else a, x)
+
+
+def functionalize(layer, forward=None):
+    """Return (pure_fn, params, buffers):
+    pure_fn(params, buffers, key, *args, **kwargs) -> (outputs, new_buffers).
+
+    `params`/`buffers` are dicts of jax arrays. The pure_fn is trace-safe:
+    module-level RNG splits from `key`, batch-norm style buffer mutation is
+    returned functionally.
+    """
+    state = _SwappedState(layer)
+    fwd = forward or layer.__call__
+
+    def pure_fn(param_datas, buffer_datas, key, *args, **kwargs):
+        _rng.push_trace_key(key)
+        try:
+            t_args = jax.tree.map(
+                lambda a: Tensor(a) if isinstance(a, jax.Array) else a, args)
+            t_kwargs = jax.tree.map(
+                lambda a: Tensor(a) if isinstance(a, jax.Array) else a, kwargs)
+            out, new_buffers = state.run(param_datas, buffer_datas, t_args, t_kwargs, fwd)
+            return _tree_to_data(out), new_buffers
+        finally:
+            _rng.pop_trace_key()
+
+    params = {k: p._data for k, p in state.params.items()}
+    buffers = {k: b._data for k, b in state.buffers.items()}
+    return pure_fn, params, buffers
+
+
+class StaticFunction:
+    """Callable wrapper produced by to_static (mirrors the reference's
+    StaticFunction from `jit/dy2static/program_translator.py`)."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None, backend=None):
+        self._fn = function
+        self._layer = function if isinstance(function, Layer) else None
+        self._jitted = None
+        self._state = None
+
+    def _build(self):
+        if self._layer is not None:
+            pure_fn, params, buffers = functionalize(self._layer)
+            self._pure_fn = pure_fn
+            self._jitted = jax.jit(pure_fn)
+        else:
+            fn = self._fn
+
+            def pure_fn(key, *args, **kwargs):
+                _rng.push_trace_key(key)
+                try:
+                    t_args = jax.tree.map(
+                        lambda a: Tensor(a) if isinstance(a, jax.Array) else a, args)
+                    t_kwargs = jax.tree.map(
+                        lambda a: Tensor(a) if isinstance(a, jax.Array) else a, kwargs)
+                    with no_grad():
+                        out = fn(*t_args, **t_kwargs)
+                    return _tree_to_data(out)
+                finally:
+                    _rng.pop_trace_key()
+
+            self._jitted = jax.jit(pure_fn)
+            self._pure_fn = pure_fn
+
+    def __call__(self, *args, **kwargs):
+        if self._jitted is None:
+            self._build()
+        key = _rng.next_key()
+        arg_datas = _tree_to_data(args)
+        kwarg_datas = _tree_to_data(kwargs)
+        if self._layer is not None:
+            state = _SwappedState(self._layer)
+            params = {k: p._data for k, p in state.params.items()}
+            buffers = {k: b._data for k, b in state.buffers.items()}
+            out, new_buffers = self._jitted(params, buffers, key, *arg_datas, **kwarg_datas)
+            for k, b in state.buffers.items():
+                b._data = new_buffers[k]
+            return _tree_to_tensor(out)
+        out = self._jitted(key, *arg_datas, **kwarg_datas)
+        return _tree_to_tensor(out)
+
+    # reference-compat introspection
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """@paddle.jit.to_static — compile a Layer or function with XLA."""
+
+    def decorator(fn):
+        if isinstance(fn, Layer):
+            return StaticFunction(fn, input_spec, build_strategy, backend)
+        sf = StaticFunction(fn, input_spec, build_strategy, backend)
+        functools.update_wrapper(sf, fn, assigned=("__name__", "__doc__"), updated=())
+        return sf
+
+    if function is not None:
+        return decorator(function)
+    return decorator
+
+
+def not_to_static(fn):
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+class TracedLayer:
+    def __init__(self, static_fn):
+        self._fn = static_fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        sf = StaticFunction(layer)
+        out = sf(*inputs)
+        return out, TracedLayer(sf)
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save (reference `jit/api.py:955`): persist weights + a program
+    descriptor; the TPU inference Predictor reloads and recompiles."""
+    import os
+    import pickle
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    target = layer._layer if isinstance(layer, StaticFunction) else layer
+    state = {k: v.numpy() for k, v in target.state_dict().items()}
+    meta = {
+        "class": type(target).__name__,
+        "input_spec": input_spec,
+    }
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **configs):
+    import pickle
+
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    with open(path + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+
+    class LoadedLayer(Layer):
+        def __init__(self):
+            super().__init__()
+            from paddle_tpu.nn.layer.layers import Parameter
+
+            self._state = {k: Parameter(jnp.asarray(v)) for k, v in state.items()}
+            for k, p in self._state.items():
+                self.add_parameter(k.replace(".", "__"), p)
+
+        def forward(self, *args):
+            raise NotImplementedError(
+                "jit.load restores weights; rebuild the architecture and call "
+                "set_state_dict, or use paddle_tpu.inference for saved predictors")
+
+        def state_dict(self, *a, **kw):
+            return dict(self._state)
+
+    return LoadedLayer()

@@ -1,0 +1,13 @@
+"""paddle.nn.functional surface (reference: `python/paddle/nn/functional/__init__.py`)."""
+
+from paddle_tpu.nn.functional.activation import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.common import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.conv import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.pooling import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.norm import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.loss import *  # noqa: F401,F403
+from paddle_tpu.nn.functional.flash_attention import (  # noqa: F401
+    flash_attention,
+    scaled_dot_product_attention,
+    sdp_kernel,
+)

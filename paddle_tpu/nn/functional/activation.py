@@ -1,0 +1,202 @@
+"""Activation functionals (reference: `python/paddle/nn/functional/activation.py`).
+
+All map to XLA-fusable elementwise primitives; XLA fuses them into adjacent
+matmuls so none of these costs an extra HBM round-trip under jit.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+
+def relu(x, name=None):
+    return apply(jax.nn.relu, x, _name="relu")
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def relu6(x, name=None):
+    return apply(jax.nn.relu6, x, _name="relu6")
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x, _name="sigmoid")
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x, _name="tanh")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda a: jax.nn.gelu(a, approximate=approximate), x, _name="gelu")
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, x, _name="silu")
+
+
+swish = silu
+
+
+def mish(x, name=None):
+    return apply(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x, _name="mish")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda a: jax.nn.leaky_relu(a, negative_slope), x, _name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(a, w):
+        if w.size > 1:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a >= 0, a, w * a)
+
+    return apply(fn, x, weight, _name="prelu")
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    if training:
+        from paddle_tpu.framework import random as _rng
+
+        def fn(a):
+            neg = jax.random.uniform(_rng.next_key(), a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, neg * a)
+
+        return apply(fn, x, _name="rrelu")
+    mid = (lower + upper) / 2.0
+    return apply(lambda a: jnp.where(a >= 0, a, mid * a), x, _name="rrelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.elu(a, alpha), x, _name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x, _name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda a: jax.nn.celu(a, alpha), x, _name="celu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda a: jnp.clip(a, min, max), x, _name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x, _name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        x, _name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda a: a - jnp.tanh(a), x, _name="tanhshrink")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x, _name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, _name="hardswish")
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply(
+        lambda a: jnp.where(beta * a > threshold, a, jax.nn.softplus(beta * a) / beta),
+        x, _name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x, _name="softsign")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from paddle_tpu.framework import dtypes
+
+    dt = dtypes.convert_dtype(dtype)
+
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.softmax(a, axis=axis)
+
+    return apply(fn, x, _name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from paddle_tpu.framework import dtypes
+
+    dt = dtypes.convert_dtype(dtype)
+
+    def fn(a):
+        if dt is not None:
+            a = a.astype(dt)
+        return jax.nn.log_softmax(a, axis=axis)
+
+    return apply(fn, x, _name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from paddle_tpu.framework import random as _rng
+
+    key = _rng.next_key()
+
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y).at[
+                tuple(jnp.indices(y.shape)[i] if i != axis % y.ndim else jnp.broadcast_to(idx, y.shape)
+                      for i in range(y.ndim))
+            ].set(0)
+            hard_y = (jnp.arange(y.shape[axis]).reshape(
+                [-1 if i == axis % y.ndim else 1 for i in range(y.ndim)]) == idx).astype(y.dtype)
+            return jax.lax.stop_gradient(hard_y - y) + y
+        return y
+
+    return apply(fn, x, _name="gumbel_softmax")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(a):
+        shape = list(a.shape)
+        c = shape[axis]
+        shape[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(a.reshape(shape), axis=axis + 1)
+
+    return apply(fn, x, _name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda a: jax.nn.glu(a, axis=axis), x, _name="glu")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply(lambda a: jnp.where(a > threshold, a, value), x, _name="thresholded_relu")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x, _name="log_sigmoid")

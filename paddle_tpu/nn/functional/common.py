@@ -1,0 +1,272 @@
+"""Common functionals: linear, dropout, padding, embedding, one_hot,
+interpolate, unfold (reference: `python/paddle/nn/functional/common.py`,
+`input.py`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply
+from paddle_tpu.framework import random as _rng
+
+
+def linear(x, weight, bias=None, name=None):
+    # paddle stores weight as [in, out] (reference nn/layer/common.py Linear)
+    if bias is not None:
+        return apply(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias, _name="linear")
+    return apply(lambda a, w: jnp.matmul(a, w), x, weight, _name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return x if mode == "upscale_in_train" else x * (1.0 - p)
+    key = _rng.next_key()
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [ax % a.ndim for ax in axes] else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply(fn, x, _name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    a = (1.0 - p + p * alpha_p ** 2 * (1.0 - p)) ** -0.5
+    b = -a * alpha_p * p
+    key = _rng.next_key()
+
+    def fn(t):
+        keep = jax.random.bernoulli(key, 1.0 - p, t.shape)
+        return (a * jnp.where(keep, t, alpha_p) + b).astype(t.dtype)
+
+    return apply(fn, x, _name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None, norm_type=2.0, name=None):
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+    def fn(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx != padding_idx)[..., None]
+            out = jnp.where(mask, out, 0.0)
+        return out
+
+    return apply(fn, weight, _name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(idx, num_classes, dtype=jnp.float32))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) else jnp.asarray(prior_dist)
+            return (1.0 - epsilon) * l + epsilon * pd
+        return (1.0 - epsilon) * l + epsilon / k
+
+    return apply(fn, label, _name="label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=True, name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        # full-rank paddle format: [d0_l, d0_r, d1_l, d1_r, ...]
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec applies to spatial dims per data_format, innermost last
+        n_spatial = len(pad) // 2
+        width = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        spatial = spatial[-n_spatial:]
+        for i, d in enumerate(reversed(spatial)):
+            width[d] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+
+    def fn(a):
+        if jmode == "constant":
+            return jnp.pad(a, width, mode="constant", constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+
+    return apply(fn, x, _name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    nd = x.ndim
+    cf = data_format.startswith("NC")
+    spatial = x.shape[2:] if cf else x.shape[1:-1]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        out_spatial = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in size]
+    else:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * len(spatial)
+        out_spatial = [int(s * f) for s, f in zip(spatial, scale_factor)]
+
+    method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear",
+              "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(a):
+        if cf:
+            shape = list(a.shape[:2]) + out_spatial
+        else:
+            shape = [a.shape[0]] + out_spatial + [a.shape[-1]]
+        return jax.image.resize(a, tuple(shape), method=method)
+
+    return apply(fn, x, _name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])))
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=tuple(ks), window_strides=tuple(st),
+            padding="VALID", rhs_dilation=tuple(dl),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, c * ks[0] * ks[1], -1)
+
+    return apply(fn, x, _name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    # inverse of unfold via scatter-add
+    os = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+
+    def fn(a):
+        n, ckk, l = a.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (os[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (os[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        a = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, os[0] + 2 * pd[0], os[1] + 2 * pd[1]), a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hi = i * dl[0]
+                wi = j * dl[1]
+                out = out.at[:, :, hi:hi + oh * st[0]:st[0], wi:wi + ow * st[1]:st[1]].add(a[:, :, i, j])
+        return out[:, :, pd[0]:out.shape[2] - pd[0], pd[1]:out.shape[3] - pd[1]]
+
+    return apply(fn, x, _name="fold")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.sqrt(jnp.sum(a * a, axis=axis)) * jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return num / jnp.maximum(den, eps)
+
+    return apply(fn, x1, x2, _name="cosine_similarity")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, c // (r * r), r, r)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply(fn, x, _name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = jnp.transpose(a, (0, 2, 4, 5, 1, 3))
+        return a.reshape(n, h // r, w // r, c * r * r)
+
+    return apply(fn, x, _name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return jnp.swapaxes(a, 1, 2).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return jnp.swapaxes(a, 3, 4).reshape(n, h, w, c)
+
+    return apply(fn, x, _name="channel_shuffle")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    if bias is not None:
+        return apply(fn, x1, x2, weight, bias, _name="bilinear")
+    return apply(fn, x1, x2, weight, _name="bilinear")

@@ -1,0 +1,152 @@
+"""Convolutions via lax.conv_general_dilated — XLA lowers these onto the MXU
+(reference op surface: `python/paddle/nn/functional/conv.py`)."""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import apply
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    strides = _pair(stride, n)
+    dil = _pair(dilation, n)
+    pad = _padding(padding, n)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + "DHW"[3 - n:]
+    else:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+    rhs_spec = "OI" + "DHW"[3 - n:]
+    out_spec = lhs_spec
+    dn = (lhs_spec, rhs_spec, out_spec)
+
+    def fn(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None)
+        if out.dtype != a.dtype:
+            out = out.astype(a.dtype)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[lhs_spec.index("C")] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    if bias is not None:
+        return apply(fn, x, weight, bias, _name=f"conv{n}d")
+    return apply(fn, x, weight, _name=f"conv{n}d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, data_format)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, data_format, output_size=None):
+    strides = _pair(stride, n)
+    dil = _pair(dilation, n)
+    opad = _pair(output_padding, n)
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + "DHW"[3 - n:]
+    else:
+        lhs_spec = "N" + "DHW"[3 - n:] + "C"
+    # paddle conv_transpose weight layout: [in, out/groups, *k]
+    rhs_spec = "IO" + "DHW"[3 - n:]
+    dn = (lhs_spec, rhs_spec, lhs_spec)
+
+    if isinstance(padding, str):
+        pad_cfg = padding.upper()
+    else:
+        p = _padding(padding, n)
+        # transposed conv padding: lax handles via negative-lookahead formula
+        pad_cfg = [
+            (dil[i] * (weight.shape[2 + i] - 1) - p[i][0],
+             dil[i] * (weight.shape[2 + i] - 1) - p[i][1] + opad[i])
+            for i in range(n)
+        ]
+
+    def fn(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, jnp.flip(w, axis=tuple(range(2, 2 + n))),
+            window_strides=(1,) * n, padding=pad_cfg,
+            lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=(lhs_spec, "OI" + "DHW"[3 - n:], lhs_spec),
+            feature_group_count=groups) if groups == 1 else _grouped(a, w, b)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[lhs_spec.index("C")] = b[0].shape[0]
+            out = out + b[0].reshape(bias_shape)
+        return out
+
+    def _grouped(a, w, b):
+        # split channels per group and run each; groups are rare in transpose
+        a_groups = jnp.split(a, groups, axis=lhs_spec.index("C"))
+        w_groups = jnp.split(w, groups, axis=0)
+        outs = []
+        for ag, wg in zip(a_groups, w_groups):
+            outs.append(jax.lax.conv_general_dilated(
+                ag, jnp.flip(wg, axis=tuple(range(2, 2 + n))),
+                window_strides=(1,) * n, padding=pad_cfg,
+                lhs_dilation=strides, rhs_dilation=dil,
+                dimension_numbers=(lhs_spec, "OI" + "DHW"[3 - n:], lhs_spec)))
+        return jnp.concatenate(outs, axis=lhs_spec.index("C"))
+
+    # weight [in, out/groups, *k] -> as "OI" we need [out, in/groups, *k]:
+    # swap and handle groups by transposing per-group
+    def prep(w):
+        return jnp.swapaxes(w, 0, 1)
+
+    import paddle_tpu as _p
+
+    wt = apply(prep, weight, _name="convT_w")
+    if bias is not None:
+        return apply(fn, x, wt, bias, _name=f"conv{n}d_transpose")
+    return apply(fn, x, wt, _name=f"conv{n}d_transpose")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 1, data_format, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, 3, data_format, output_size)

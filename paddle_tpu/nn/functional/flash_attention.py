@@ -1,0 +1,80 @@
+"""Flash attention (reference dispatch: `python/paddle/nn/functional/flash_attention.py:486-530`;
+reference kernel: `paddle/phi/kernels/gpu/flash_attn_kernel.cu`).
+
+TPU-native design: a Pallas splash-style kernel (`paddle_tpu/kernels/flash_attention.py`)
+when running on TPU, otherwise an XLA softmax(QK^T)V fallback that the compiler
+fuses. Layout is paddle's [batch, seqlen, nheads, headdim].
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+
+def _sdpa_reference(q, k, v, causal=False, dropout=0.0, scale=None, mask=None):
+    # q/k/v: [B, L, H, D] -> compute in [B, H, L, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if mask is not None:
+        logits = logits + mask.astype(logits.dtype)
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        causal_mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(causal_mask, logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _use_pallas(q):
+    return jax.default_backend() == "tpu" and q.shape[-1] % 128 == 0 and q.shape[1] % 128 == 0
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    def fn(q, k, v):
+        if _use_pallas(q):
+            try:
+                from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+
+                return flash_attention_fwd(q, k, v, causal=causal)
+            except Exception:
+                pass
+        return _sdpa_reference(q, k, v, causal=causal)
+
+    out = apply(fn, query, key, value, _name="flash_attention")
+    return out, None
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError("varlen flash attention: use dense + mask on TPU")
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    m = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+
+    def fn(q, k, v):
+        if m is None and _use_pallas(q):
+            try:
+                from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+
+                return flash_attention_fwd(q, k, v, causal=is_causal)
+            except Exception:
+                pass
+        return _sdpa_reference(q, k, v, causal=is_causal, mask=m)
+
+    return apply(fn, query, key, value, _name="sdpa")
+
+
+def sdp_kernel(*args, **kwargs):
+    import contextlib
+
+    return contextlib.nullcontext()

@@ -1,0 +1,299 @@
+"""Loss functionals (reference: `python/paddle/nn/functional/loss.py`)."""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    lbl = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def fn(logits, *w):
+        lg = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, axis=axis) if use_softmax else jnp.log(jnp.maximum(lg, 1e-30))
+        if soft_label:
+            tgt = lbl.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+        else:
+            l = lbl
+            if l.ndim == logits.ndim and l.shape[axis] == 1:
+                l = jnp.squeeze(l, axis)
+            valid = l != ignore_index
+            l_safe = jnp.where(valid, l, 0)
+            picked = jnp.take_along_axis(logp, l_safe[..., None].astype(jnp.int32), axis=axis)[..., 0]
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                smooth = jnp.mean(logp, axis=axis)
+                picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+            loss = jnp.where(valid, -picked, 0.0)
+            wt = None
+            if w:
+                wt = jnp.take(w[0], l_safe, axis=0) * valid.astype(loss.dtype)
+                loss = loss * wt
+            if reduction == "mean":
+                # weighted mean divides by the sum of sample weights
+                # (reference semantics, `python/paddle/nn/functional/loss.py`)
+                denom = jnp.sum(wt) if wt is not None else jnp.sum(valid.astype(jnp.float32))
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, reduction)
+
+    args = [weight] if weight is not None else []
+    return apply(fn, input, *args, _name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from paddle_tpu.ops.manipulation import unsqueeze
+
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        from paddle_tpu.nn.functional.activation import softmax as _sm
+
+        return loss, _sm(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    lbl = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+
+    def fn(logp, *w):
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None].astype(jnp.int32), axis=1)[..., 0] if logp.ndim == 2 \
+            else jnp.take_along_axis(logp, safe[:, None].astype(jnp.int32), axis=1)[:, 0]
+        loss = jnp.where(valid, -picked, 0.0)
+        wt = None
+        if w:
+            wt = jnp.take(w[0], safe, axis=0) * valid.astype(loss.dtype)
+            loss = loss * wt
+        if reduction == "mean":
+            denom = jnp.sum(wt) if wt is not None else jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [weight] if weight is not None else []
+    return apply(fn, input, *args, _name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label, _name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label, _name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply(fn, input, label, _name="smooth_l1")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return apply(fn, input, label, _name="huber_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def fn(p, t, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [weight] if weight is not None else []
+    return apply(fn, input, label, *args, _name="bce")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def fn(z, t, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        neg_abs = -jnp.abs(z)
+        if pw is not None:
+            log_weight = (pw - 1) * t + 1
+            loss = (1 - t) * z + log_weight * (jnp.log1p(jnp.exp(neg_abs)) + jnp.maximum(-z, 0))
+        else:
+            loss = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(neg_abs))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    args = [t for t in (weight, pos_weight) if t is not None]
+    return apply(fn, logit, label, *args, _name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-30)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return apply(fn, input, label, _name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(
+        lambda a, b, t: _reduce(jnp.maximum(0.0, -t * (a - b) + margin), reduction),
+        input, other, label, _name="margin_ranking")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(
+        lambda a, t: _reduce(jnp.where(t == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        input, label, _name="hinge_embedding")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def fn(a, b, t):
+        cos = jnp.sum(a * b, -1) / (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        loss = jnp.where(t == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return apply(fn, input1, input2, label, _name="cosine_embedding")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def fn(a, pos, neg):
+        dp = jnp.power(jnp.sum(jnp.power(jnp.abs(a - pos), p), -1) + epsilon, 1 / p)
+        dn = jnp.power(jnp.sum(jnp.power(jnp.abs(a - neg), p), -1) + epsilon, 1 / p)
+        if swap:
+            dsn = jnp.power(jnp.sum(jnp.power(jnp.abs(pos - neg), p), -1) + epsilon, 1 / p)
+            dn = jnp.minimum(dn, dsn)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply(fn, input, positive, negative, _name="triplet_margin")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def fn(z, t, *n):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * t + (1 - p) * (1 - t)
+        a_t = alpha * t + (1 - alpha) * (1 - t)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+
+    args = [normalizer] if normalizer is not None else []
+    return apply(fn, logit, label, *args, _name="focal")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(
+        lambda p, t: -t * jnp.log(p + epsilon) - (1 - t) * jnp.log(1 - p + epsilon),
+        input, label, _name="log_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label, _name="square_error")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean",
+             norm_by_times=False):
+    # CTC via the standard forward algorithm in log space (lax.scan over time)
+    lp = log_probs._data.astype(jnp.float32)  # [T, B, C] paddle layout
+    lbl = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+    il = input_lengths._data if isinstance(input_lengths, Tensor) else jnp.asarray(input_lengths)
+    ll = label_lengths._data if isinstance(label_lengths, Tensor) else jnp.asarray(label_lengths)
+
+    def fn(lp_):
+        logp = jax.nn.log_softmax(lp_, axis=-1)
+        T, B, C = logp.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        ext = jnp.full((B, S), blank, dtype=lbl.dtype)
+        ext = ext.at[:, 1::2].set(lbl)
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(logp[0], ext[:, 1:2].astype(jnp.int32), axis=1)[:, 0])
+
+        same = jnp.concatenate([jnp.ones((B, 2), bool),
+                                ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            emit = jnp.take_along_axis(lp_t, ext.astype(jnp.int32), axis=1)
+            return merged + emit, None
+
+        def scan_t(carry, t):
+            alpha = carry
+            new_alpha, _ = step(alpha, logp[t])
+            alpha = jnp.where((t >= 1) & (t < il)[:, None], new_alpha, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_t, alpha0, jnp.arange(T))
+        end1 = 2 * ll - 1
+        end2 = 2 * ll
+        a1 = jnp.take_along_axis(alpha, end1[:, None].astype(jnp.int32), axis=1)[:, 0]
+        a2 = jnp.take_along_axis(alpha, end2[:, None].astype(jnp.int32), axis=1)[:, 0]
+        loss = -jnp.logaddexp(a1, a2)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(ll.astype(jnp.float32), 1.0))
+        return _reduce(loss, reduction)
+
+    return apply(fn, log_probs, _name="ctc_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, l):
+        sim = a @ p.T
+        lbl = l.reshape(-1, 1)
+        target = (lbl == lbl.T).astype(jnp.float32)
+        target = target / target.sum(-1, keepdims=True)
+        ce = -jnp.sum(target * jax.nn.log_softmax(sim, -1), -1)
+        reg = l2_reg * (jnp.sum(a * a) + jnp.sum(p * p)) / (2 * a.shape[0])
+        return jnp.mean(ce) + reg
+
+    return apply(fn, anchor, positive, labels, _name="npair")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, t):
+        t1 = jax.nn.one_hot(t[..., 0].astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        inter = jnp.sum(p * t1, axis=tuple(range(1, p.ndim)))
+        union = jnp.sum(p, axis=tuple(range(1, p.ndim))) + jnp.sum(t1, axis=tuple(range(1, p.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply(fn, input, label, _name="dice")

@@ -1,0 +1,193 @@
+"""Normalization functionals (reference: `python/paddle/nn/functional/norm.py`).
+
+rms_norm/fused paths mirror the reference's fused kernels
+(`paddle/phi/kernels/fusion/gpu/fused_bias_dropout_residual_layer_norm_kernel.cu`,
+`fused_rms_norm`); on TPU, XLA fuses these chains natively and the pallas
+variants live in `paddle_tpu/kernels/`.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    axes = tuple(range(-n_axes, 0))
+
+    def fn(a, *wb):
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return apply(fn, x, *args, _name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    def fn(a, *w):
+        var = jnp.mean(jnp.square(a.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = a.astype(jnp.float32) * jax.lax.rsqrt(var + epsilon)
+        out = out.astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = [weight] if weight is not None else []
+    return apply(fn, x, *args, _name="rms_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    cf = data_format.startswith("NC")
+    ch_axis = 1 if (cf and x.ndim > 1) else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats; update running stats in-place (host side-effect,
+        # matches the reference's mutable mean/var outputs)
+        mean = jnp.mean(x._data.astype(jnp.float32), axis=reduce_axes)
+        var = jnp.var(x._data.astype(jnp.float32), axis=reduce_axes)
+        if running_mean is not None:
+            running_mean._data = (momentum * running_mean._data + (1.0 - momentum) * mean).astype(running_mean.dtype)
+            n = x.size // x.shape[ch_axis]
+            unbiased = var * (n / max(n - 1, 1))
+            running_var._data = (momentum * running_var._data + (1.0 - momentum) * unbiased).astype(running_var.dtype)
+
+        def fn(a, *wb):
+            m = jnp.mean(a.astype(jnp.float32), axis=reduce_axes, keepdims=True)
+            v = jnp.var(a.astype(jnp.float32), axis=reduce_axes, keepdims=True)
+            out = (a.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon)
+            out = out.astype(a.dtype)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(shape)
+            return out
+    else:
+        rm = running_mean._data.reshape(shape)
+        rv = running_var._data.reshape(shape)
+
+        def fn(a, *wb):
+            out = (a - rm.astype(a.dtype)) * jax.lax.rsqrt(rv.astype(jnp.float32) + epsilon).astype(a.dtype)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(shape)
+            return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return apply(fn, x, *args, _name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else tuple(range(1, x.ndim - 1))
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    def fn(a, *wb):
+        m = jnp.mean(a.astype(jnp.float32), axis=reduce_axes, keepdims=True)
+        v = jnp.var(a.astype(jnp.float32), axis=reduce_axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - m) * jax.lax.rsqrt(v + eps)).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return apply(fn, x, *args, _name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    cf = data_format.startswith("NC")
+    ch_axis = 1 if cf else x.ndim - 1
+    c = x.shape[ch_axis]
+    shape = [1] * x.ndim
+    shape[ch_axis] = c
+
+    def fn(a, *wb):
+        if cf:
+            n = a.shape[0]
+            g = a.reshape((n, num_groups, c // num_groups) + a.shape[2:])
+            axes = tuple(range(2, g.ndim))
+        else:
+            n = a.shape[0]
+            g = a.reshape((n,) + a.shape[1:-1] + (num_groups, c // num_groups))
+            axes = tuple(range(1, g.ndim - 2)) + (g.ndim - 1,)
+        m = jnp.mean(g.astype(jnp.float32), axis=axes, keepdims=True)
+        v = jnp.var(g.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((g.astype(jnp.float32) - m) * jax.lax.rsqrt(v + epsilon)).astype(a.dtype)
+        out = out.reshape(a.shape)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [t for t in (weight, bias) if t is not None]
+    return apply(fn, x, *args, _name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+
+    def fn(a):
+        sq = jnp.square(a)
+        half = size // 2
+        pads = [(0, 0)] * a.ndim
+        pads[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        window = [1] * a.ndim
+        window[ch_axis] = size
+        summed = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(window), (1,) * a.ndim, "VALID")
+        return a / jnp.power(k + alpha * summed, beta)
+
+    return apply(fn, x, _name="lrn")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(a):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+
+    return apply(fn, x, _name="normalize")
+
+
+def spectral_norm(weight, weight_u, weight_v, dim=0, power_iters=1, eps=1e-12, name=None):
+    def fn(w, u, v):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / sigma
+
+    return apply(fn, weight, weight_u, weight_v, _name="spectral_norm")

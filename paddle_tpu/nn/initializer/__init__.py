@@ -1,0 +1,184 @@
+"""Initializers (reference: `python/paddle/nn/initializer/`)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.framework import dtypes, random as _rng
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+    def _compute_fans(self, shape):
+        if len(shape) == 0:
+            return 1, 1
+        if len(shape) == 1:
+            return shape[0], shape[0]
+        if len(shape) == 2:
+            return shape[0], shape[1]
+        receptive = int(np.prod(shape[2:]))
+        return shape[1] * receptive, shape[0] * receptive
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value, dtypes.convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, name=None):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        dt = dtypes.convert_dtype(dtype)
+        return self.mean + self.std * jax.random.normal(_rng.next_key(), tuple(shape), dt)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0, name=None):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        dt = dtypes.convert_dtype(dtype)
+        lo = (self.a - self.mean) / self.std
+        hi = (self.b - self.mean) / self.std
+        out = jax.random.truncated_normal(_rng.next_key(), lo, hi, tuple(shape), jnp.float32)
+        return (self.mean + self.std * out).astype(dt)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0, name=None):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        dt = dtypes.convert_dtype(dtype)
+        return jax.random.uniform(_rng.next_key(), tuple(shape), dt, self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fin, fout = self._compute_fans(shape)
+        fin = self.fan_in or fin
+        fout = self.fan_out or fout
+        std = self.gain * math.sqrt(2.0 / (fin + fout))
+        dt = dtypes.convert_dtype(dtype)
+        return std * jax.random.normal(_rng.next_key(), tuple(shape), dt)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0, name=None):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fin, fout = self._compute_fans(shape)
+        fin = self.fan_in or fin
+        fout = self.fan_out or fout
+        limit = self.gain * math.sqrt(6.0 / (fin + fout))
+        dt = dtypes.convert_dtype(dtype)
+        return jax.random.uniform(_rng.next_key(), tuple(shape), dt, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fin, _ = self._compute_fans(shape)
+        fin = self.fan_in or fin
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        std = gain / math.sqrt(fin)
+        dt = dtypes.convert_dtype(dtype)
+        return std * jax.random.normal(_rng.next_key(), tuple(shape), dt)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fin, _ = self._compute_fans(shape)
+        fin = self.fan_in or fin
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        limit = gain * math.sqrt(3.0 / fin)
+        dt = dtypes.convert_dtype(dtype)
+        return jax.random.uniform(_rng.next_key(), tuple(shape), dt, -limit, limit)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = jax.random.normal(_rng.next_key(), (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diag(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtypes.convert_dtype(dtype))
+
+
+class Assign(Initializer):
+    def __init__(self, value, name=None):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from paddle_tpu.core.tensor import Tensor
+
+        v = self.value
+        arr = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+        return arr.reshape(tuple(shape)).astype(dtypes.convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        out = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(oc // self.groups, ic)):
+                idx = (g * (oc // self.groups) + i, i) + tuple(centers)
+                out[idx] = 1.0
+        return jnp.asarray(out).astype(dtypes.convert_dtype(dtype))
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity == "tanh":
+        return 5.0 / 3
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = param if param is not None else 0.01
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4
+    return 1.0
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    # stored for create_parameter default lookup
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
+_global_weight_init = None
+_global_bias_init = None

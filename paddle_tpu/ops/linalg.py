@@ -1,0 +1,274 @@
+"""Linear algebra ops (reference: `python/paddle/tensor/linalg.py`).
+
+matmuls run on the MXU; keep them batched and let XLA tile. The dygraph path
+here mirrors `linalg.py:220,320` matmul -> _C_ops.matmul.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(fn, x, y, _name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y, _name="bmm")
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: (a * b).sum(-1), x, y, _name="dot")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec, _name="mv")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y, _name="outer")
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else _find_dim3(x)
+    return apply(lambda a, b: jnp.cross(a, b, axis=ax), x, y, _name="cross")
+
+
+def _find_dim3(x):
+    for i, s in enumerate(x.shape):
+        if s == 3:
+            return i
+    return -1
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y, _name="addmm")
+
+
+def einsum(equation, *operands):
+    ops = list(operands[0]) if len(operands) == 1 and isinstance(operands[0], (list, tuple)) else list(operands)
+    from paddle_tpu.core.tensor import apply_multi
+
+    return apply_multi(lambda arrs: jnp.einsum(equation, *arrs), ops, _name="einsum")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    if p is None:
+        p = "fro" if (ax is None or isinstance(ax, tuple)) else 2
+
+    def fn(a):
+        if ax is None:
+            flat = a.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.sqrt(jnp.sum(flat * flat))
+            if p == np.inf or p == "inf":
+                return jnp.max(jnp.abs(flat))
+            if p == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            if p == 0:
+                return jnp.sum((flat != 0).astype(a.dtype))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == np.inf or p == "inf":
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        if p == 1:
+            return jnp.sum(jnp.abs(a), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim), 1.0 / p)
+
+    return apply(fn, x, _name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return norm(x, p=p, axis=list(axis), keepdim=keepdim)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(apply(jnp.subtract, x, y, _name="sub"), p=float(p))
+
+
+def cond(x, p=None, name=None):
+    return Tensor(jnp.linalg.cond(x._data, p=p))
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, _name="det")
+
+
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x._data)
+    return Tensor(jnp.stack([sign, logdet]))
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, x, _name="inverse")
+
+
+inv = inverse
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x, _name="pinv")
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+
+    return apply(fn, x, _name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        lf = jnp.swapaxes(l, -1, -2) if upper else l
+        z = jax.scipy.linalg.solve_triangular(lf, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(lf, -1, -2), z, lower=False)
+
+    return apply(fn, x, y, _name="cholesky_solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    return apply(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular),
+        x, y, _name="triangular_solve")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, _name="solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x._data, y._data, rcond=rcond)
+    return Tensor(sol), Tensor(res), Tensor(rank), Tensor(sv)
+
+
+def qr(x, mode="reduced", name=None):
+    q, r = jnp.linalg.qr(x._data, mode=mode)
+    return Tensor(q), Tensor(r)
+
+
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x._data, full_matrices=full_matrices)
+    return Tensor(u), Tensor(s), Tensor(jnp.swapaxes(vh, -1, -2))
+
+
+def eig(x, name=None):
+    w, v = jnp.linalg.eig(np.asarray(x._data))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    w, v = jnp.linalg.eigh(x._data, UPLO=UPLO)
+    return Tensor(w), Tensor(v)
+
+
+def eigvals(x, name=None):
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._data))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return Tensor(jnp.linalg.eigvalsh(x._data, UPLO=UPLO))
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x, _name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(x._data, rtol=tol))
+
+
+def multi_dot(x, name=None):
+    from paddle_tpu.core.tensor import apply_multi
+
+    return apply_multi(lambda arrs: jnp.linalg.multi_dot(arrs), list(x), _name="multi_dot")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    arr = np.asarray(input._data)
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = np.histogram(arr, bins=bins, range=rng)
+    return Tensor(jnp.asarray(hist.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    arr = np.asarray(x._data)
+    w = np.asarray(weights._data) if weights is not None else None
+    hist, edges = np.histogramdd(arr, bins=bins, range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    w = weights._data if weights is not None else None
+    return Tensor(jnp.bincount(x._data, weights=w, minlength=minlength))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return Tensor(jnp.corrcoef(x._data, rowvar=rowvar))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = np.asarray(fweights._data) if fweights is not None else None
+    aw = np.asarray(aweights._data) if aweights is not None else None
+    return Tensor(jnp.cov(x._data, rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=fw, aweights=aw))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
+    piv = piv + 1  # paddle uses 1-based pivots (LAPACK convention)
+    if get_infos:
+        return Tensor(lu_), Tensor(piv), Tensor(jnp.zeros((), jnp.int32))
+    return Tensor(lu_), Tensor(piv)
+
+
+def matrix_exp(x, name=None):
+    return apply(jax.scipy.linalg.expm, x, _name="matrix_exp")
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(t.shape[-1]):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1:, i]])
+            q = q - t[i] * (q @ jnp.outer(v, v))
+        return q[:, :n]
+
+    return apply(fn, x, tau, _name="householder_product")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    a = x._data
+    if q is None:
+        q = min(6, a.shape[-2], a.shape[-1])
+    if center:
+        a = a - a.mean(-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return Tensor(u[..., :q]), Tensor(s[..., :q]), Tensor(jnp.swapaxes(vh, -1, -2)[..., :q])

@@ -1,0 +1,56 @@
+"""Comparison/logical ops (reference: `python/paddle/tensor/logic.py`)."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, to_tensor
+
+
+def _cmp(jfn, name):
+    def op(x, y, name=None):
+        a = x._data if isinstance(x, Tensor) else x
+        b = y._data if isinstance(y, Tensor) else y
+        return Tensor(jfn(a, b))
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    return Tensor(jnp.logical_not(x._data))
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(x._data, y._data))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(x._data, y._data, rtol=float(rtol), atol=float(atol),
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(x._data, y._data, rtol=float(rtol), atol=float(atol),
+                              equal_nan=equal_nan))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def isreal(x, name=None):
+    return Tensor(jnp.isreal(x._data))

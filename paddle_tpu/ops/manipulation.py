@@ -1,0 +1,479 @@
+"""Shape/layout manipulation ops (reference: `python/paddle/tensor/manipulation.py`)."""
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply, apply_multi, to_tensor
+
+
+def _int_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    shp = _int_shape(shape)
+    return apply(lambda a: jnp.reshape(a, shp), x, _name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._data = jnp.reshape(x._data, _int_shape(shape))
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return x.astype(shape_or_dtype)
+
+
+def transpose(x, perm, name=None):
+    perm = [int(p) for p in perm]
+    return apply(lambda a: jnp.transpose(a, perm), x, _name="transpose")
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        return x
+    return transpose(x, [1, 0])
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x, _name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis1, axis2), x, _name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = [t if isinstance(t, Tensor) else to_tensor(t) for t in x]
+    return apply_multi(lambda arrs: jnp.concatenate(arrs, axis=axis), tensors, _name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = [t if isinstance(t, Tensor) else to_tensor(t) for t in x]
+    return apply_multi(lambda arrs: jnp.stack(arrs, axis=axis), tensors, _name="stack")
+
+
+def hstack(x, name=None):
+    return apply_multi(lambda arrs: jnp.hstack(arrs), list(x), _name="hstack")
+
+
+def vstack(x, name=None):
+    return apply_multi(lambda arrs: jnp.vstack(arrs), list(x), _name="vstack")
+
+
+def dstack(x, name=None):
+    return apply_multi(lambda arrs: jnp.dstack(arrs), list(x), _name="dstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"paddle.split: axis {axis} length {dim} is not divisible by "
+                f"num_or_sections={num_or_sections}")
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        n_neg = sizes.count(-1)
+        if n_neg:
+            rest = dim - sum(s for s in sizes if s >= 0)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes)[:-1]
+    outs = apply(
+        lambda a: tuple(
+            jax.lax.dynamic_slice_in_dim(a, int(o), int(s), axis) for o, s in zip(offsets, sizes)
+        ),
+        x, _name="split",
+    )
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    outs = apply(
+        lambda a: tuple(jnp.squeeze(s, axis) for s in jnp.split(a, n, axis)),
+        x, _name="unbind",
+    )
+    return list(outs)
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return apply(lambda a: jnp.squeeze(a), x, _name="squeeze")
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a) for a in axes if x.shape[int(a)] == 1)
+    return apply(lambda a: jnp.squeeze(a, axis=axes) if axes else a, x, _name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a) for a in axes)
+    return apply(lambda a: jnp.expand_dims(a, axes), x, _name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return reshape(x, [1])
+    sa = start_axis % nd
+    ea = stop_axis % nd
+    shp = x.shape
+    new_shape = shp[:sa] + [int(np.prod(shp[sa:ea + 1]))] + shp[ea + 1:]
+    return reshape(x, new_shape)
+
+
+def expand(x, shape, name=None):
+    shp = list(_int_shape(shape))
+    # paddle semantics: -1 means keep this dim
+    xs = x.shape
+    off = len(shp) - len(xs)
+    for i, s in enumerate(shp):
+        if s == -1:
+            shp[i] = xs[i - off]
+    return apply(lambda a: jnp.broadcast_to(a, tuple(shp)), x, _name="expand")
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shp = jnp.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [expand(t, list(shp)) for t in inputs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _int_shape(repeat_times)
+    return apply(lambda a: jnp.tile(a, reps), x, _name="tile")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return apply(lambda a: jnp.repeat(a, r, axis=axis), x, _name="repeat_interleave")
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = tuple(int(a) for a in axes)
+    return apply(lambda a: jnp.flip(a, axis=axes), x, _name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, _name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else shifts
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(lambda a: jnp.roll(a, sh, axis=ax), x, _name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return apply(lambda a: jnp.take(a, idx, axis=axis), x, _name="gather")
+
+
+def gather_nd(x, index, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    nd = idx.shape[-1]
+
+    def fn(a):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return apply(fn, x, _name="gather_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return apply(lambda a: jnp.take_along_axis(a, idx, axis=axis), arr, _name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", include_self=True,
+                   broadcast=True, name=None):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+
+    def fn(a, v):
+        v = jnp.broadcast_to(v, idx.shape) if jnp.ndim(v) == 0 else v
+        full_idx = []
+        for d in range(a.ndim):
+            if d == axis % a.ndim:
+                full_idx.append(idx)
+            else:
+                shape = [1] * a.ndim
+                shape[d] = a.shape[d]
+                ar = jnp.arange(a.shape[d]).reshape(shape)
+                full_idx.append(jnp.broadcast_to(ar, idx.shape))
+        ref = a.at[tuple(full_idx)]
+        if reduce == "assign":
+            return ref.set(v)
+        if reduce in ("add", "sum"):
+            return ref.add(v)
+        if reduce in ("mul", "multiply"):
+            return ref.multiply(v)
+        if reduce == "amax":
+            return ref.max(v)
+        if reduce == "amin":
+            return ref.min(v)
+        raise ValueError(f"unknown reduce {reduce}")
+
+    if isinstance(values, Tensor):
+        return apply(fn, arr, values, _name="put_along_axis")
+    return apply(lambda a: fn(a, jnp.asarray(values, a.dtype)), arr, _name="put_along_axis")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a, u):
+        if overwrite:
+            return a.at[idx].set(u)
+        # paddle: overwrite=False sums contributions after zeroing targets
+        zeroed = a.at[idx].set(0.0)
+        return zeroed.at[idx].add(u)
+
+    return apply(fn, x, updates, _name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a, u):
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+
+    return apply(fn, x, updates, _name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from paddle_tpu.ops.creation import zeros
+
+    base = zeros(shape, dtype=updates.dtype)
+    return scatter_nd_add(base, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply(lambda a: jnp.take(a, idx, axis=axis), x, _name="index_select")
+
+
+def index_sample(x, index):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply(lambda a: jnp.take_along_axis(a, idx, axis=1), x, _name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved.at[idx].add(jnp.moveaxis(v, axis, 0))
+        return jnp.moveaxis(out, 0, axis)
+
+    return apply(fn, x, value, _name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i._data if isinstance(i, Tensor) else jnp.asarray(i) for i in indices)
+
+    def fn(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+
+    if isinstance(value, Tensor):
+        return apply(fn, x, value, _name="index_put")
+    return apply(lambda a: fn(a, jnp.asarray(value, a.dtype)), x, _name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    m = np.asarray(m)  # data-dependent output shape: host round-trip, eager only
+    return Tensor(x._data[jnp.asarray(m)])
+
+
+def masked_fill(x, mask, value, name=None):
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    v = value._data if isinstance(value, Tensor) else value
+    return apply(lambda a: jnp.where(m, jnp.asarray(v, a.dtype), a), x, _name="masked_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    m = np.asarray(mask._data if isinstance(mask, Tensor) else mask)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    flat_idx = np.nonzero(m.reshape(-1))[0]
+
+    def fn(a):
+        flat = a.reshape(-1)
+        return flat.at[jnp.asarray(flat_idx)].set(v.reshape(-1)[: flat_idx.size]).reshape(a.shape)
+
+    return apply(fn, x, _name="masked_scatter")
+
+
+def where(condition, x=None, y=None, name=None):
+    cond = condition._data if isinstance(condition, Tensor) else jnp.asarray(condition)
+    if x is None and y is None:
+        nz = np.nonzero(np.asarray(cond))
+        return [Tensor(jnp.asarray(i.astype(np.int64))) for i in nz]
+    if isinstance(x, Tensor) and isinstance(y, Tensor):
+        return apply(lambda a, b: jnp.where(cond, a, b), x, y, _name="where")
+    if isinstance(x, Tensor):
+        return apply(lambda a: jnp.where(cond, a, y), x, _name="where")
+    if isinstance(y, Tensor):
+        return apply(lambda b: jnp.where(cond, x, b), y, _name="where")
+    return Tensor(jnp.where(cond, x, y))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def fn(a, v):
+        sl = [slice(None)] * a.ndim
+        sl[axis] = index
+        return a.at[tuple(sl)].set(v)
+
+    return apply(fn, x, values, _name="select_scatter")
+
+
+def slice(input, axes, starts, ends, name=None):
+    def _v(s):
+        return int(s.item()) if isinstance(s, Tensor) else int(s)
+
+    sl = [builtins_slice(None)] * input.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = builtins_slice(_v(st), _v(en))
+    sl = tuple(sl)
+    return apply(lambda a: a[sl], input, _name="slice")
+
+
+builtins_slice = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    sl = [builtins_slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[ax] = builtins_slice(int(st), int(en), int(sd))
+    sl = tuple(sl)
+    return apply(lambda a: a[sl], x, _name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _int_shape(shape)
+    offs = _int_shape(offsets) if offsets is not None else (0,) * x.ndim
+    sl = tuple(builtins_slice(o, o + s) for o, s in zip(offs, shp))
+    return apply(lambda a: a[sl], x, _name="crop")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0], bool)
+    keep[1:] = np.any(arr[1:] != arr[:-1], axis=tuple(range(1, arr.ndim))) if arr.ndim > 1 else arr[1:] != arr[:-1]
+    out = [Tensor(jnp.asarray(arr[keep]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        counts = np.diff(np.append(idx, arr.shape[0]))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, _name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), x, _name="as_real")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def fn(a):
+        in_shard = (a // shard_size) == shard_id
+        return jnp.where(in_shard, a % shard_size, ignore_value)
+
+    return apply(fn, input, _name="shard_index")
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(int(i) for i in a) if isinstance(a, (list, tuple)) else int(a) for a in ax)
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y, _name="tensordot")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [reshape(x, [1]) if x.ndim == 0 else x for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        while x.ndim < 2:
+            x = unsqueeze(x, 0)
+        outs.append(x)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = []
+    for x in inputs:
+        while x.ndim < 3:
+            x = unsqueeze(x, -1) if x.ndim >= 2 else unsqueeze(x, 0)
+        outs.append(x)
+    return outs[0] if len(outs) == 1 else outs

@@ -1,0 +1,332 @@
+"""Elementwise math + reductions (reference: `python/paddle/tensor/math.py`,
+`python/paddle/tensor/ops.py`)."""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply, to_tensor
+
+# --------------------------------------------------------------------------
+# factories
+# --------------------------------------------------------------------------
+
+
+def _unary(jfn, name):
+    def op(x, name=None):
+        return apply(jfn, x, _name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _binary(jfn, name):
+    def op(x, y, name=None):
+        if isinstance(x, Tensor) and isinstance(y, Tensor):
+            return apply(jfn, x, y, _name=name)
+        if isinstance(x, Tensor):
+            return apply(lambda a: jfn(a, y), x, _name=name)
+        if isinstance(y, Tensor):
+            return apply(lambda b: jfn(x, b), y, _name=name)
+        return to_tensor(jfn(x, y))
+
+    op.__name__ = name
+    return op
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduction(jfn, name, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        ax = _axes(axis)
+        return apply(lambda a: jfn(a, axis=ax, keepdims=keepdim), x, _name=name)
+
+    op.__name__ = name
+    return op
+
+
+# --------------------------------------------------------------------------
+# unary
+# --------------------------------------------------------------------------
+
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(jax.lax.rsqrt, "rsqrt")
+square = _unary(jnp.square, "square")
+abs = _unary(jnp.abs, "abs")
+sign = _unary(jnp.sign, "sign")
+neg = _unary(jnp.negative, "neg")
+negative = neg
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda a: a - jnp.trunc(a), "frac")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+erf = _unary(jax.lax.erf, "erf")
+erfinv = _unary(jax.lax.erf_inv, "erfinv")
+sigmoid = _unary(jax.nn.sigmoid, "sigmoid")
+logit = _unary(jax.scipy.special.logit, "logit")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+i0 = _unary(jax.scipy.special.i0, "i0")
+i1 = _unary(jax.scipy.special.i1, "i1")
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+angle = _unary(jnp.angle, "angle")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+exponent = _unary(lambda a: jnp.floor(jnp.log2(jnp.abs(a))), "exponent")
+
+# --------------------------------------------------------------------------
+# binary
+# --------------------------------------------------------------------------
+
+add = _binary(jnp.add, "add")
+subtract = _binary(jnp.subtract, "subtract")
+multiply = _binary(jnp.multiply, "multiply")
+divide = _binary(jnp.divide, "divide")
+floor_divide = _binary(jnp.floor_divide, "floor_divide")
+mod = _binary(jnp.mod, "mod")
+remainder = mod
+floor_mod = mod
+pow = _binary(jnp.power, "pow")
+maximum = _binary(jnp.maximum, "maximum")
+minimum = _binary(jnp.minimum, "minimum")
+fmax = _binary(jnp.fmax, "fmax")
+fmin = _binary(jnp.fmin, "fmin")
+atan2 = _binary(jnp.arctan2, "atan2")
+hypot = _binary(jnp.hypot, "hypot")
+logaddexp = _binary(jnp.logaddexp, "logaddexp")
+copysign = _binary(jnp.copysign, "copysign")
+nextafter = _binary(jnp.nextafter, "nextafter")
+ldexp = _binary(lambda a, b: a * jnp.power(2.0, b).astype(a.dtype) if jnp.issubdtype(a.dtype, jnp.floating) else (a * (2 ** b)), "ldexp")
+heaviside = _binary(jnp.heaviside, "heaviside")
+gcd = _binary(jnp.gcd, "gcd")
+lcm = _binary(jnp.lcm, "lcm")
+inner = _binary(jnp.inner, "inner")
+outer = _binary(jnp.outer, "outer")
+kron = _binary(jnp.kron, "kron")
+
+bitwise_and = _binary(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _binary(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _binary(jnp.bitwise_xor, "bitwise_xor")
+bitwise_not = _unary(jnp.bitwise_not, "bitwise_not")
+bitwise_left_shift = _binary(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _binary(jnp.right_shift, "bitwise_right_shift")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        out = apply(lambda a: a * s + bias, x, _name="scale")
+    else:
+        out = apply(lambda a: (a + bias) * s, x, _name="scale")
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return apply(lambda a: jnp.clip(a, mn, mx), x, _name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), x, y, weight, _name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), x, y, _name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, _name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    from paddle_tpu.core.tensor import apply_multi
+
+    return apply_multi(
+        lambda ins, idx: jnp.stack(ins, 0)[idx.reshape(-1), jnp.arange(ins[0].shape[0])],
+        inputs, index, _name="multiplex")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x, _name="nan_to_num")
+
+
+# --------------------------------------------------------------------------
+# reductions
+# --------------------------------------------------------------------------
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from paddle_tpu.framework import dtypes
+
+    dt = dtypes.convert_dtype(dtype)
+    ax = _axes(axis)
+    return apply(lambda a: jnp.sum(a, axis=ax, dtype=dt, keepdims=keepdim), x, _name="sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.mean(a, axis=ax, keepdims=keepdim), x, _name="mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, _name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, _name="min")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from paddle_tpu.framework import dtypes
+
+    dt = dtypes.convert_dtype(dtype)
+    ax = _axes(axis)
+    return apply(lambda a: jnp.prod(a, axis=ax, dtype=dt, keepdims=keepdim), x, _name="prod")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return Tensor(jnp.all(x._data, axis=ax, keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return Tensor(jnp.any(x._data, axis=ax, keepdims=keepdim))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim), x, _name="logsumexp")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.nansum(a, axis=ax, keepdims=keepdim), x, _name="nansum")
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.nanmean(a, axis=ax, keepdims=keepdim), x, _name="nanmean")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return Tensor(jnp.count_nonzero(x._data, axis=ax, keepdims=keepdim).astype(jnp.int64))
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        return apply(lambda a: jnp.cumsum(a.reshape(-1)), x, _name="cumsum")
+    return apply(lambda a: jnp.cumsum(a, axis=int(axis)), x, _name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda a: jnp.cumprod(a, axis=dim), x, _name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    vals = jax.lax.cummax(x._data, axis=axis if axis is not None else 0)
+    idx = jnp.argmax(jnp.cumsum(jnp.ones_like(x._data, jnp.int32), axis=axis or 0) * 0 + 0, axis=0)
+    return Tensor(vals), Tensor(idx)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    vals = jax.lax.cummin(x._data, axis=axis if axis is not None else 0)
+    return Tensor(vals), Tensor(jnp.zeros_like(vals, jnp.int64))
+
+
+def logcumsumexp(x, axis=None, name=None):
+    ax = axis if axis is not None else 0
+    a = x._data if axis is not None else x._data.reshape(-1)
+    return Tensor(jax.lax.associative_scan(jnp.logaddexp, a, axis=ax))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x, _name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x, _name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim,
+                                        method=interpolation), x, _name="quantile")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x, _name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axes(axis)
+    return apply(lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x, _name="var")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x, _name="trace")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._data if isinstance(prepend, Tensor) else prepend
+    app = append._data if isinstance(append, Tensor) else append
+    return apply(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), x, _name="diff")
+
+
+def increment(x, value=1.0, name=None):
+    x._data = x._data + value
+    return x
+
+
+def accuracy_check(x, y, fn_name="", rtol=1e-5, atol=1e-8, equal_nan=False):
+    """Cross-run tensor comparison op (reference `ops.yaml:31` accuracy_check,
+    `paddle/phi/kernels/accuracy_check_kernel.h`)."""
+    ok = bool(jnp.allclose(x._data, y._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+    if not ok:
+        raise AssertionError(f"accuracy_check failed for {fn_name}")
+    return Tensor(jnp.asarray(ok))

@@ -1,0 +1,110 @@
+"""Search/sort ops (reference: `python/paddle/tensor/search.py`)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply
+from paddle_tpu.ops.manipulation import take_along_axis
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    if axis is None:
+        out = jnp.argmax(x._data.reshape(-1))
+        return Tensor(out.astype(jnp.int64))
+    out = jnp.argmax(x._data, axis=int(axis), keepdims=keepdim)
+    return Tensor(out.astype(jnp.int64))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    if axis is None:
+        out = jnp.argmin(x._data.reshape(-1))
+        return Tensor(out.astype(jnp.int64))
+    out = jnp.argmin(x._data, axis=int(axis), keepdims=keepdim)
+    return Tensor(out.astype(jnp.int64))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    idx = jnp.argsort(x._data, axis=axis, descending=descending, stable=stable)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    idx = jnp.argsort(x._data, axis=axis, descending=descending, stable=stable)
+    return take_along_axis(x, Tensor(idx), axis=axis)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else int(axis)
+    moved = jnp.moveaxis(x._data, ax, -1)
+    if largest:
+        idx = jnp.argsort(-moved, axis=-1)[..., :k]
+    else:
+        idx = jnp.argsort(moved, axis=-1)[..., :k]
+    idx = jnp.moveaxis(idx, -1, ax)
+    vals = take_along_axis(x, Tensor(idx), axis=ax)
+    return vals, Tensor(idx.astype(jnp.int64))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    nz = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64)).reshape(-1, 1)) for i in nz)
+    if len(nz) == 0:
+        return Tensor(jnp.zeros((0, x.ndim), jnp.int64))
+    return Tensor(jnp.asarray(np.stack(nz, -1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    v = values._data if isinstance(values, Tensor) else values
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence._data, v, side=side)
+    else:
+        import jax
+
+        out = jax.vmap(lambda s, q: jnp.searchsorted(s, q, side=side))(
+            sorted_sequence._data.reshape(-1, sorted_sequence.shape[-1]),
+            v.reshape(-1, v.shape[-1]),
+        ).reshape(v.shape)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    arr = np.asarray(x._data)
+    moved = np.moveaxis(arr, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals, idxs = [], []
+    for row in flat:
+        u, c = np.unique(row, return_counts=True)
+        v = u[np.argmax(c)]
+        vals.append(v)
+        idxs.append(np.where(row == v)[0][-1])
+    out_shape = moved.shape[:-1]
+    v = np.array(vals).reshape(out_shape)
+    i = np.array(idxs).reshape(out_shape)
+    if keepdim:
+        v = np.expand_dims(v, axis)
+        i = np.expand_dims(i, axis)
+    return Tensor(jnp.asarray(v)), Tensor(jnp.asarray(i.astype(np.int64)))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    moved = jnp.moveaxis(x._data, axis, -1)
+    idx = jnp.argsort(moved, axis=-1)[..., k - 1]
+    vals = jnp.take_along_axis(moved, idx[..., None], axis=-1)[..., 0]
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return Tensor(vals), Tensor(idx.astype(jnp.int64))
+
+
+def index_sample(x, index):
+    from paddle_tpu.ops.manipulation import index_sample as _is
+
+    return _is(x, index)

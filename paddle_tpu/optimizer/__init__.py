@@ -1,0 +1,4 @@
+from paddle_tpu.optimizer.optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp, Lamb,
+)
+from paddle_tpu.optimizer import lr  # noqa: F401

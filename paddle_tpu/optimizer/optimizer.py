@@ -1,0 +1,325 @@
+"""Optimizers (reference: `python/paddle/optimizer/optimizer.py:128` base,
+`adamw.py`, `momentum.py`).
+
+Eager path updates parameters in-place with XLA-compiled elementwise chains.
+The compiled trainer (`paddle_tpu.hapi` / `paddle_tpu.jit`) uses the same
+`_update_rule` as a pure function over pytrees, fused into one program per
+step — the analogue of the reference's fused multi-tensor `_C_ops.adamw_`.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, no_grad
+from paddle_tpu.nn.layer.layers import Parameter
+from paddle_tpu.optimizer.lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._accumulators = {}
+        self._step_count = 0
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return self._learning_rate
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- state --------------------------------------------------------------
+    def _acc(self, name, param, init=None):
+        key = (name, id(param))
+        if key not in self._accumulators:
+            self._accumulators[key] = jnp.zeros_like(param._data) if init is None else init
+        return self._accumulators[key]
+
+    def _set_acc(self, name, param, value):
+        self._accumulators[(name, id(param))] = value
+
+    def state_dict(self):
+        """Accumulators are keyed by the parameter's position in the parameter
+        list, which is stable across processes (id() is not)."""
+        sd = {}
+        id_to_idx = {id(p): i for i, p in enumerate(self._parameter_list or [])}
+        for (name, pid), v in self._accumulators.items():
+            idx = id_to_idx.get(pid)
+            if idx is not None:
+                sd[f"{name}@p{idx}"] = Tensor(v) if hasattr(v, "shape") else v
+        sd["@step"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = state_dict.get("@step", 0)
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        params = self._parameter_list or []
+        for key, v in state_dict.items():
+            if "@p" not in key:
+                continue
+            name, idx_s = key.rsplit("@p", 1)
+            idx = int(idx_s)
+            if idx < len(params):
+                data = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                self._accumulators[(name, id(params[idx]))] = data
+
+    # -- grad plumbing -------------------------------------------------------
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def _apply_grad_clip(self, params_grads):
+        clip = self._grad_clip
+        if clip is None:
+            return params_grads
+        from paddle_tpu import nn
+
+        if isinstance(clip, nn.ClipGradByGlobalNorm):
+            clipable = [(p, g) for p, g in params_grads if getattr(p, "need_clip", True)]
+            if not clipable:
+                return params_grads
+            total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for _, g in clipable))
+            coef = jnp.minimum(clip.clip_norm / jnp.maximum(total, 1e-6), 1.0)
+            return [(p, (g * coef.astype(g.dtype)) if getattr(p, "need_clip", True) else g)
+                    for p, g in params_grads]
+        if isinstance(clip, nn.ClipGradByNorm):
+            out = []
+            for p, g in params_grads:
+                if getattr(p, "need_clip", True):
+                    n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                    coef = jnp.minimum(clip.clip_norm / jnp.maximum(n, 1e-6), 1.0)
+                    g = g * coef.astype(g.dtype)
+                out.append((p, g))
+            return out
+        if isinstance(clip, nn.ClipGradByValue):
+            return [(p, jnp.clip(g, clip.min, clip.max) if getattr(p, "need_clip", True) else g)
+                    for p, g in params_grads]
+        return params_grads
+
+    # -- the update ---------------------------------------------------------
+    def _update_param(self, p, g, lr):
+        raise NotImplementedError
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    def step(self):
+        assert self._parameter_list is not None, "optimizer created without parameters"
+        with no_grad():
+            params_grads = [(p, p.grad._data) for p in self._parameter_list
+                            if p.grad is not None and not p.stop_gradient]
+            params_grads = self._apply_grad_clip(params_grads)
+            lr = self.get_lr()
+            self._step_count += 1
+            for p, g in params_grads:
+                plr = lr * p.optimize_attr.get("learning_rate", 1.0) if isinstance(p, Parameter) else lr
+                if p.regularizer is not None and hasattr(p.regularizer, "coeff"):
+                    g = g + p.regularizer.coeff * p._data
+                self._update_param(p, g, plr)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def _apply_optimize(self, loss=None, startup_program=None, params_grads=None):
+        self.step()
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_param(self, p, g, lr):
+        if self._weight_decay:
+            g = g + float(self._weight_decay) * p._data
+        p._data = (p._data - lr * g).astype(p.dtype)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_param(self, p, g, lr):
+        if self._weight_decay:
+            g = g + float(self._weight_decay) * p._data
+        v = self._acc("velocity", p)
+        v = self._momentum * v + g
+        self._set_acc("velocity", p, v)
+        if self._nesterov:
+            p._data = (p._data - lr * (g + self._momentum * v)).astype(p.dtype)
+        else:
+            p._data = (p._data - lr * v).astype(p.dtype)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g, lr):
+        if self._weight_decay:
+            g = g + float(self._weight_decay) * p._data
+        m = self._acc("moment", p, jnp.full_like(p._data, self._init_acc))
+        m = m + g * g
+        self._set_acc("moment", p, m)
+        p._data = (p._data - lr * g / (jnp.sqrt(m) + self._epsilon)).astype(p.dtype)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update_param(self, p, g, lr):
+        if self._weight_decay:
+            g = g + float(self._weight_decay) * p._data
+        ms = self._acc("mean_square", p)
+        ms = self._rho * ms + (1 - self._rho) * g * g
+        self._set_acc("mean_square", p, ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            mg = self._rho * mg + (1 - self._rho) * g
+            self._set_acc("mean_grad", p, mg)
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        v = self._acc("velocity", p)
+        v = self._momentum * v + lr * g / denom
+        self._set_acc("velocity", p, v)
+        p._data = (p._data - v).astype(p.dtype)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+
+    def _decay(self, p, g):
+        if self._weight_decay:
+            return g + float(self._weight_decay) * p._data
+        return g
+
+    def _update_param(self, p, g, lr):
+        g = self._decay(p, g)
+        self._adam_update(p, g, lr)
+
+    def _adam_update(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        m = self._acc("moment1", p, jnp.zeros_like(p._data, jnp.float32))
+        v = self._acc("moment2", p, jnp.zeros_like(p._data, jnp.float32))
+        t = self._step_count
+        m = self._beta1 * m + (1 - self._beta1) * g32
+        v = self._beta2 * v + (1 - self._beta2) * g32 * g32
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        master = self._acc("master", p, p._data.astype(jnp.float32)) if self._multi_precision else p._data.astype(jnp.float32)
+        new = master - lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if self._multi_precision:
+            self._set_acc("master", p, new)
+        p._data = new.astype(p.dtype)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: `python/paddle/optimizer/adamw.py`)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None,
+                         grad_clip, lazy_mode, multi_precision, name)
+        self._wd = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update_param(self, p, g, lr):
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        decay = self._wd
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        if decay:
+            p._data = (p._data * (1.0 - lr * decay)).astype(p.dtype)
+        self._adam_update(p, g, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr):
+        if self._weight_decay:
+            g = g + float(self._weight_decay) * p._data
+        m = self._acc("moment", p)
+        u = self._acc("inf_norm", p)
+        t = self._step_count
+        m = self._beta1 * m + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * u, jnp.abs(g))
+        self._set_acc("moment", p, m)
+        self._set_acc("inf_norm", p, u)
+        p._data = (p._data - lr / (1 - self._beta1 ** t) * m / (u + self._epsilon)).astype(p.dtype)
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr):
+        m = self._acc("moment1", p)
+        v = self._acc("moment2", p)
+        t = self._step_count
+        m = self._beta1 * m + (1 - self._beta1) * g
+        v = self._beta2 * v + (1 - self._beta2) * g * g
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        update = r + wd * p._data
+        w_norm = jnp.linalg.norm(p._data)
+        u_norm = jnp.linalg.norm(update)
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        p._data = (p._data - lr * ratio * update).astype(p.dtype)

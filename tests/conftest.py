@@ -1,0 +1,31 @@
+"""Test config: run on a virtual 8-device CPU mesh (mirrors the reference's
+fake-device test rig, `test/custom_runtime/test_custom_cpu_plugin.py:27-47`:
+a CPU masquerading as the accelerator drives the same code paths).
+
+Note: the session's sitecustomize registers the axon TPU-tunnel PJRT plugin
+and force-sets jax_platforms="axon,cpu" via jax.config (overriding the env
+var), so we must override the *config* back to cpu before any backend init.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

@@ -1,0 +1,131 @@
+"""io DataLoader + hapi Model.fit end-to-end (config 1: LeNet/MNIST — the
+BASELINE.json minimum slice; reference loop `python/paddle/hapi/model.py:1472`)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import BatchSampler, DataLoader, Dataset, TensorDataset, DistributedBatchSampler
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+class RangeDataset(Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32([i]), np.int64([i % 2])
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_batches():
+    loader = DataLoader(RangeDataset(10), batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4, 1]
+    assert str(y.dtype) == "int64" or str(y.dtype) == "int32"
+
+
+def test_dataloader_shuffle_drop_last():
+    loader = DataLoader(RangeDataset(10), batch_size=4, shuffle=True, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+
+
+def test_dataloader_prefetch_worker():
+    loader = DataLoader(RangeDataset(8), batch_size=2, num_workers=2)
+    assert len(list(loader)) == 4
+
+
+def test_batch_sampler():
+    bs = BatchSampler(RangeDataset(10), batch_size=3, drop_last=False)
+    assert len(bs) == 4
+    assert sum(len(b) for b in bs) == 10
+
+
+def test_distributed_batch_sampler_shards():
+    ds = RangeDataset(16)
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=1)
+    b0 = [i for b in s0 for i in b]
+    b1 = [i for b in s1 for i in b]
+    assert len(b0) == len(b1) == 4
+    assert not set(b0) & set(b1)
+
+
+def test_mnist_dataset():
+    ds = MNIST(mode="train")
+    img, label = ds[0]
+    assert img.shape == (1, 28, 28)
+    assert 0 <= int(label[0]) < 10
+
+
+def test_model_fit_lenet_mnist():
+    """Config 1: LeNet on MNIST via Model.fit — loss must decrease."""
+    paddle.seed(42)
+    train = MNIST(mode="train")
+    model = paddle.Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+
+    # capture initial loss
+    x0 = paddle.to_tensor(np.stack([train[i][0] for i in range(32)]))
+    y0 = paddle.to_tensor(np.stack([train[i][1] for i in range(32)]))
+    init_loss = float(nn.CrossEntropyLoss()(model.network(x0), y0))
+
+    model.fit(train, epochs=1, batch_size=64, verbose=0, num_iters=20)
+
+    final_loss = float(nn.CrossEntropyLoss()(model.network(x0), y0))
+    assert final_loss < init_loss, (init_loss, final_loss)
+
+
+def test_model_evaluate_predict():
+    val = MNIST(mode="test")
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.SGD(0.01, parameters=model.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    res = model.evaluate(val, batch_size=64, verbose=0)
+    assert "loss" in res and "acc" in res
+    preds = model.predict(val, batch_size=64)
+    assert preds[0][0].shape[-1] == 10
+
+
+def test_model_save_load(tmp_path):
+    model = paddle.Model(LeNet())
+    model.prepare(paddle.optimizer.SGD(0.01, parameters=model.parameters()),
+                  nn.CrossEntropyLoss())
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+    w0 = model.network.state_dict()["features.0.weight"].numpy().copy()
+    # perturb then reload
+    model.network.state_dict()["features.0.weight"]._data = (
+        model.network.state_dict()["features.0.weight"]._data * 0.0)
+    model.load(path)
+    np.testing.assert_allclose(
+        model.network.state_dict()["features.0.weight"].numpy(), w0)
+
+
+def test_paddle_save_load(tmp_path):
+    obj = {"w": paddle.ones([2, 2]), "step": 3, "nested": [paddle.zeros([1])]}
+    p = str(tmp_path / "obj.pdparams")
+    paddle.save(obj, p)
+    loaded = paddle.load(p)
+    np.testing.assert_allclose(loaded["w"].numpy(), np.ones((2, 2)))
+    assert loaded["step"] == 3
+
+
+def test_accuracy_metric():
+    m = Accuracy()
+    pred = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2]])
+    label = paddle.to_tensor([[1], [1]], dtype="int64")
+    c = m.compute(pred, label)
+    m.update(c)
+    assert abs(m.accumulate() - 0.5) < 1e-6

@@ -1,0 +1,226 @@
+"""nn layer tests (reference test strategy: `test/legacy_test/` per-op tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = layer(x)
+    assert y.shape == [2, 3]
+    np.testing.assert_allclose(
+        y.numpy(), x.numpy() @ layer.weight.numpy() + layer.bias.numpy(), rtol=1e-5)
+
+
+def test_linear_backward_to_params():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    loss = layer(x).sum()
+    loss.backward()
+    assert layer.weight.grad is not None and layer.weight.grad.shape == [4, 3]
+    assert layer.bias.grad is not None
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = conv(x)
+    assert y.shape == [2, 8, 8, 8]
+
+
+def test_conv2d_matches_numpy_1x1():
+    conv = nn.Conv2D(2, 4, 1, bias_attr=False)
+    x = paddle.randn([1, 2, 5, 5])
+    y = conv(x)
+    w = conv.weight.numpy()  # [4, 2, 1, 1]
+    expected = np.einsum("nchw,oc->nohw", x.numpy(), w[:, :, 0, 0])
+    np.testing.assert_allclose(y.numpy(), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_maxpool_avgpool():
+    x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    mp = F.max_pool2d(x, 2, 2)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = F.avg_pool2d(x, 2, 2)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5])
+    bn.train()
+    y = bn(x)
+    out = y.numpy()
+    np.testing.assert_allclose(out.mean(axis=(0, 2, 3)), np.zeros(3), atol=1e-5)
+    np.testing.assert_allclose(out.std(axis=(0, 2, 3)), np.ones(3), atol=1e-2)
+    # running stats moved off init
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == y.shape
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8])
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), np.zeros((2, 4)), atol=1e-5)
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = paddle.randn([2, 8])
+    y = rn(x).numpy()
+    expected = x.numpy() / np.sqrt((x.numpy() ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, expected, rtol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor([[1, 2], [3, 4]], dtype="int64")
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_embedding_grad():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor([0, 0, 1], dtype="int64")
+    emb(idx).sum().backward()
+    g = emb.weight.grad.numpy()
+    np.testing.assert_allclose(g[0], 2 * np.ones(4))
+    np.testing.assert_allclose(g[1], np.ones(4))
+    np.testing.assert_allclose(g[2], np.zeros(4))
+
+
+def test_dropout_train_eval():
+    drop = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    drop.train()
+    y = drop(x)
+    frac_zero = float((y.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    drop.eval()
+    np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+
+
+def test_activations():
+    x = paddle.to_tensor([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(F.relu(x).numpy(), [0, 0, 2])
+    np.testing.assert_allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp([1, 0, -2])), rtol=1e-6)
+    np.testing.assert_allclose(F.softmax(x).numpy().sum(), 1.0, rtol=1e-6)
+    assert F.gelu(x).shape == [3]
+    np.testing.assert_allclose(F.leaky_relu(x).numpy(), [-0.01, 0, 2], rtol=1e-6)
+
+
+def test_cross_entropy():
+    logits = paddle.to_tensor([[2.0, 1.0, 0.1], [0.5, 2.5, 0.3]])
+    labels = paddle.to_tensor([0, 1], dtype="int64")
+    loss = F.cross_entropy(logits, labels)
+    expected = -np.log(
+        np.exp([2.0, 2.5]) / np.exp(logits.numpy()).sum(-1))
+    np.testing.assert_allclose(float(loss), expected.mean(), rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor([1, -100, 2, -100], dtype="int64")
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    l0 = F.cross_entropy(logits[np.array([0, 2])], paddle.to_tensor([1, 2], dtype="int64"))
+    np.testing.assert_allclose(float(loss), float(l0), rtol=1e-5)
+
+
+def test_mse_l1():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([2.0, 4.0])
+    np.testing.assert_allclose(float(F.mse_loss(a, b)), 2.5)
+    np.testing.assert_allclose(float(F.l1_loss(a, b)), 1.5)
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert seq(x).shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_state_dict_roundtrip():
+    model = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    sd = model.state_dict()
+    assert set(sd.keys()) == {"0.weight", "0.bias", "1.weight", "1.bias"}
+    model2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    model2.set_state_dict({k: v for k, v in sd.items()})
+    for k in sd:
+        np.testing.assert_allclose(model2.state_dict()[k].numpy(), sd[k].numpy())
+
+
+def test_named_parameters_and_buffers():
+    bn = nn.BatchNorm1D(4)
+    names = dict(bn.named_parameters())
+    assert "weight" in names and "bias" in names
+    buf_names = [n for n, _ in bn.named_buffers()]
+    assert "_mean" in buf_names and "_variance" in buf_names
+
+
+def test_forward_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h = layer.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    layer(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    layer(paddle.randn([1, 2]))
+    assert calls == [1]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 6, 16])
+    assert enc(x).shape == [2, 6, 16]
+
+
+def test_lstm():
+    lstm = nn.LSTM(4, 8, num_layers=1)
+    x = paddle.randn([2, 5, 4])
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [1, 2, 8]
+    out.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+
+
+def test_gru_bidirectional():
+    gru = nn.GRU(4, 8, direction="bidirect")
+    x = paddle.randn([2, 5, 4])
+    out, h = gru(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_interpolate():
+    x = paddle.randn([1, 3, 8, 8])
+    y = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert y.shape == [1, 3, 16, 16]
+
+
+def test_pad():
+    x = paddle.ones([1, 1, 2, 2])
+    y = F.pad(x, [1, 1, 1, 1])
+    assert y.shape == [1, 1, 4, 4]
+    assert y.numpy()[0, 0, 0, 0] == 0

@@ -1,0 +1,110 @@
+"""Optimizer tests (reference: `test/legacy_test/test_sgd_op.py`, adam tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _quadratic_step(opt_cls, **kw):
+    w = nn.Parameter(paddle.to_tensor([5.0])._data)
+    opt = opt_cls(parameters=[w], **kw)
+    losses = []
+    for _ in range(50):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def test_sgd_converges():
+    losses = _quadratic_step(paddle.optimizer.SGD, learning_rate=0.1)
+    assert losses[-1] < 1e-3 * losses[0]
+
+
+def test_momentum_converges():
+    losses = _quadratic_step(paddle.optimizer.Momentum, learning_rate=0.05, momentum=0.9)
+    assert losses[-1] < 1e-2 * losses[0]
+
+
+def test_adam_converges():
+    losses = _quadratic_step(paddle.optimizer.Adam, learning_rate=0.3)
+    assert losses[-1] < 1e-2 * losses[0]
+
+
+def test_adamw_weight_decay():
+    w1 = nn.Parameter(paddle.ones([4])._data)
+    opt = paddle.optimizer.AdamW(learning_rate=0.01, parameters=[w1], weight_decay=0.5)
+    (w1.sum() * 0.0).backward()  # zero grads
+    opt.step()
+    # pure decay shrinks weights
+    assert np.all(w1.numpy() < 1.0)
+
+
+def test_sgd_matches_manual():
+    w = nn.Parameter(paddle.to_tensor([2.0, 3.0])._data)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    (w * paddle.to_tensor([1.0, 2.0])).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [2.0 - 0.1, 3.0 - 0.2], rtol=1e-6)
+
+
+def test_grad_clip_global_norm():
+    w = nn.Parameter(paddle.to_tensor([3.0, 4.0])._data)  # grad norm will be 5
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+    (w * paddle.to_tensor([3.0, 4.0])).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [3.0 - 3.0 / 5, 4.0 - 4.0 / 5], rtol=1e-4)
+
+
+def test_lr_scheduler_step():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    w = nn.Parameter(paddle.ones([1])._data)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+    assert abs(opt.get_lr() - 0.1) < 1e-9
+    sched.step()
+    sched.step()
+    assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+def test_cosine_annealing():
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+    vals = []
+    for _ in range(10):
+        vals.append(sched())
+        sched.step()
+    assert vals[0] == 1.0
+    assert vals[-1] < 0.1
+
+
+def test_linear_warmup():
+    sched = paddle.optimizer.lr.LinearWarmup(learning_rate=0.1, warmup_steps=5,
+                                             start_lr=0.0, end_lr=0.1)
+    vals = [sched()]
+    for _ in range(6):
+        sched.step()
+        vals.append(sched())
+    assert vals[0] == 0.0
+    assert abs(vals[5] - 0.1) < 1e-9
+
+
+def test_optimizer_trains_linear_model():
+    paddle.seed(0)
+    true_w = np.array([[2.0], [-1.0]], np.float32)
+    x = np.random.rand(64, 2).astype(np.float32)
+    y = x @ true_w
+    model = nn.Linear(2, 1)
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=model.parameters())
+    loss = None
+    for _ in range(300):
+        xb = paddle.to_tensor(x)
+        pred = model(xb)
+        loss = ((pred - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < 1e-2

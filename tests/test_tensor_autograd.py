@@ -1,0 +1,181 @@
+"""Tensor + tape autograd unit tests (modeled on the reference OpTest strategy,
+`test/legacy_test/op_test.py:418`: run op, compare against NumPy, check grads)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_and_numpy():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert x.shape == [2, 2]
+    np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+    assert str(x.dtype) == "float32"
+
+
+def test_basic_arith():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    y = paddle.to_tensor([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+    np.testing.assert_allclose((x * y).numpy(), [4, 10, 18])
+    np.testing.assert_allclose((y - x).numpy(), [3, 3, 3])
+    np.testing.assert_allclose((y / x).numpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((2.0 * x).numpy(), [2, 4, 6])
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_backward_chain():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    z = (x * y + x.exp()).mean()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), (np.array([3, 4]) + np.exp([1, 2])) / 2, rtol=1e-6)
+    np.testing.assert_allclose(y.grad.numpy(), np.array([1, 2]) / 2)
+
+
+def test_backward_shared_input():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x  # dy/dx = 3x^2 = 12
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 3 * np.array([1.0, 4.0]))
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_matmul_grad():
+    a = paddle.to_tensor(np.random.rand(3, 4).astype("float32"), stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(4, 5).astype("float32"), stop_gradient=False)
+    out = paddle.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_indexing_and_grad():
+    x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4), stop_gradient=False)
+    y = x[1].sum()
+    y.backward()
+    expected = np.zeros((3, 4))
+    expected[1] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expected)
+
+
+def test_setitem():
+    x = paddle.to_tensor(np.zeros((3, 3), "float32"))
+    x[1, 1] = 5.0
+    assert x.numpy()[1, 1] == 5.0
+
+
+def test_reshape_transpose_concat():
+    x = paddle.arange(6, dtype="float32").reshape([2, 3])
+    t = paddle.transpose(x, [1, 0])
+    assert t.shape == [3, 2]
+    c = paddle.concat([x, x], axis=0)
+    assert c.shape == [4, 3]
+    s = paddle.stack([x, x], axis=0)
+    assert s.shape == [2, 2, 3]
+    parts = paddle.split(c, 2, axis=0)
+    assert len(parts) == 2 and parts[0].shape == [2, 3]
+
+
+def test_reductions():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert float(x.sum()) == 10.0
+    assert float(x.mean()) == 2.5
+    np.testing.assert_allclose(x.max(axis=0).numpy(), [3, 4])
+    np.testing.assert_allclose(x.sum(axis=1, keepdim=True).numpy(), [[3], [7]])
+
+
+def test_comparison_and_where():
+    x = paddle.to_tensor([1.0, 5.0, 3.0])
+    y = paddle.to_tensor([4.0, 2.0, 3.0])
+    mask = x > y
+    np.testing.assert_array_equal(mask.numpy(), [False, True, False])
+    out = paddle.where(mask, x, y)
+    np.testing.assert_allclose(out.numpy(), [4, 5, 3])
+
+
+def test_gather_scatter():
+    x = paddle.to_tensor(np.arange(10, dtype="float32"))
+    idx = paddle.to_tensor([1, 3, 5])
+    np.testing.assert_allclose(paddle.gather(x, idx).numpy(), [1, 3, 5])
+
+
+def test_topk_argmax_sort():
+    x = paddle.to_tensor([3.0, 1.0, 4.0, 1.0, 5.0])
+    vals, idx = paddle.topk(x, 2)
+    np.testing.assert_allclose(vals.numpy(), [5, 4])
+    np.testing.assert_array_equal(idx.numpy(), [4, 2])
+    assert int(paddle.argmax(x)) == 4
+    np.testing.assert_allclose(paddle.sort(x).numpy(), [1, 1, 3, 4, 5])
+
+
+def test_einsum():
+    a = paddle.to_tensor(np.random.rand(2, 3).astype("float32"), stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(3, 4).astype("float32"))
+    out = paddle.einsum("ij,jk->ik", a, b)
+    np.testing.assert_allclose(out.numpy(), a.numpy() @ b.numpy(), rtol=1e-5)
+    out.sum().backward()
+    assert a.grad is not None
+
+
+def test_cast_astype():
+    x = paddle.to_tensor([1.5, 2.5])
+    assert str(x.astype("int32").dtype) == "int32"
+    assert x.astype(paddle.bfloat16).dtype == paddle.bfloat16
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_tensor_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 2)
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_clip_and_clip_():
+    x = paddle.to_tensor([-2.0, 0.5, 3.0])
+    np.testing.assert_allclose(paddle.clip(x, -1, 1).numpy(), [-1, 0.5, 1])
+
+
+def test_inplace_ops():
+    x = paddle.to_tensor([1.0, 2.0])
+    x.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.numpy(), [2, 3])
+    x.scale_(2.0)
+    np.testing.assert_allclose(x.numpy(), [4, 6])
