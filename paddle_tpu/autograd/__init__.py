@@ -57,14 +57,19 @@ class PyLayer(metaclass=PyLayerMeta):
     def backward(ctx, *grads):
         raise NotImplementedError
 
+    # When True a grad node is recorded even if no *tensor input* requires
+    # grad — needed by recompute, whose parameters enter via closure (the
+    # reference always records in trace mode, py_layer.py apply).
+    _force_record = False
+
     @classmethod
     def apply(cls, *args, **kwargs):
         from paddle_tpu.core.tensor import is_grad_enabled
 
         ctx = PyLayerContext()
         with_no_grad_inputs = [a for a in args if isinstance(a, Tensor)]
-        needs_grad = is_grad_enabled() and any(
-            not t.stop_gradient for t in with_no_grad_inputs)
+        needs_grad = is_grad_enabled() and (cls._force_record or any(
+            not t.stop_gradient for t in with_no_grad_inputs))
 
         from paddle_tpu.core import tensor as _tmod
 

@@ -1,0 +1,61 @@
+"""paddle_tpu.distributed: the distributed stack, TPU-native.
+
+Reference surface: `python/paddle/distributed/` (155K LoC). The reference
+stacks Python collectives on per-rank NCCL communicators
+(`collective.py:151-180`); here everything compiles to XLA collectives over a
+`jax.sharding.Mesh` (ICI/DCN), with a single-controller runtime.
+
+Layout:
+  process_mesh / placement / api   — DTensor-style semi-auto parallel
+  collective / communication       — groups + functional collectives
+  parallel                         — init_parallel_env, DataParallel
+  fleet                            — hybrid parallel (dp/mp/pp/sharding/sep)
+  checkpoint                       — sharded save/load with reshard-on-load
+  launch                           — process launcher CLI (multi-host)
+"""
+
+from paddle_tpu.distributed.process_mesh import (  # noqa: F401
+    ProcessMesh, get_mesh, set_mesh, init_mesh,
+)
+from paddle_tpu.distributed.placement import (  # noqa: F401
+    Placement, Shard, Replicate, Partial,
+)
+from paddle_tpu.distributed.api import (  # noqa: F401
+    shard_tensor, reshard, shard_layer, dtensor_from_fn, unshard_dtensor,
+    get_placements, is_dist_tensor,
+)
+from paddle_tpu.distributed.collective import (  # noqa: F401
+    Group, new_group, get_group, is_initialized, destroy_process_group,
+)
+from paddle_tpu.distributed.communication import (  # noqa: F401
+    ReduceOp, all_reduce, all_gather, all_gather_object, reduce, broadcast,
+    scatter, reduce_scatter, alltoall, alltoall_single, send, recv, isend,
+    irecv, barrier, get_backend, P2POp, batch_isend_irecv,
+)
+from paddle_tpu.distributed.parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, ParallelEnv, DataParallel,
+)
+
+
+def __getattr__(name):
+    if name == "fleet":
+        from paddle_tpu.distributed import fleet
+
+        return fleet
+    if name == "checkpoint":
+        from paddle_tpu.distributed import checkpoint
+
+        return checkpoint
+    if name == "launch":
+        from paddle_tpu.distributed import launch
+
+        return launch
+    if name == "sharding":
+        from paddle_tpu.distributed import sharding
+
+        return sharding
+    if name == "utils":
+        from paddle_tpu.distributed import utils
+
+        return utils
+    raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

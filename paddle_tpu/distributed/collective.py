@@ -1,0 +1,127 @@
+"""Communication groups.
+
+Reference: `python/paddle/distributed/collective.py:151-180`
+(`_new_process_group_impl` -> ProcessGroupNCCL/Gloo/Custom) and
+`python/paddle/distributed/communication/group.py:29` (Group).
+
+TPU-native design: there is no per-rank communicator object to construct —
+ICI/DCN collectives are compiled into XLA programs. A Group is therefore a
+*naming*: an ordered device-id list, optionally bound to a mesh axis name.
+Collectives on a group either (a) run eagerly as sharding transitions
+(`communication.py`) or (b) lower to `lax.psum(..., axis_name)` when called
+inside shard_map/jit tracing — the axis name is the "communicator".
+"""
+
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["Group", "new_group", "get_group", "is_initialized",
+           "destroy_process_group", "_get_global_group", "_set_default_store"]
+
+_lock = threading.Lock()
+_group_map = {}
+_next_gid = [0]
+_default_store = None
+
+
+class Group:
+    def __init__(self, rank_in_group, gid, ranks, name=None, axis_name=None, mesh=None):
+        self.rank = rank_in_group
+        self.id = gid
+        self.ranks = list(ranks)
+        self.name = name or f"_default_pg{gid}"
+        # TPU-native extras: the mesh axis this group tiles (if any).
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def process_group(self):
+        return self
+
+    def is_member(self):
+        return True
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        ax = f", axis={self.axis_name}" if self.axis_name else ""
+        return f"Group(id={self.id}, ranks={self.ranks}{ax})"
+
+
+def _global_rank():
+    from paddle_tpu.distributed.parallel import get_rank
+
+    return get_rank()
+
+
+def _new_gid():
+    with _lock:
+        gid = _next_gid[0]
+        _next_gid[0] += 1
+        return gid
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None, mesh=None):
+    """Create a group over `ranks` (reference collective.py:151).
+
+    No rendezvous happens: the group is a description consumed at trace time.
+    """
+    if ranks is None:
+        ranks = list(range(jax.device_count()))
+    gid = _new_gid()
+    me = _global_rank()
+    rank_in_group = ranks.index(me) if me in ranks else -1
+    g = Group(rank_in_group, gid, ranks, axis_name=axis_name, mesh=mesh)
+    with _lock:
+        _group_map[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _group_map.get(gid)
+
+
+def _get_global_group():
+    g = _group_map.get(0)
+    if g is None:
+        from paddle_tpu.distributed.parallel import init_parallel_env
+
+        init_parallel_env()
+        g = _group_map.get(0)
+    return g
+
+
+def _register_global_group(g):
+    with _lock:
+        _group_map[0] = g
+        _next_gid[0] = max(_next_gid[0], 1)
+
+
+def is_initialized():
+    return 0 in _group_map
+
+
+def destroy_process_group(group=None):
+    with _lock:
+        if group is None:
+            _group_map.clear()
+            _next_gid[0] = 0
+        else:
+            _group_map.pop(group.id, None)
+
+
+def _set_default_store(store):
+    global _default_store
+    _default_store = store

@@ -1,0 +1,294 @@
+"""Functional collectives: all_reduce / all_gather / reduce_scatter / ...
+
+Reference: `python/paddle/distributed/communication/*.py` — each wraps
+`group.process_group.all_reduce(...)` in dygraph
+(`communication/stream/all_reduce.py:39-55`) or emits a collective op in
+static graph, over ProcessGroupNCCL (`process_group_nccl.cc:267`).
+
+TPU-native design — two execution modes, one API:
+
+1. **In-trace** (inside `shard_map`/`pjit` tracing, detected by the operand
+   being a jax Tracer): lower straight to XLA collectives — `lax.psum`,
+   `lax.all_gather`, `lax.psum_scatter`, `lax.all_to_all`, `lax.ppermute` —
+   over the group's mesh axis name. These ride ICI. This is the path fleet's
+   TP/PP layers take inside the compiled train step, and it is the moral
+   equivalent of the reference's per-group NCCL communicator: the axis name
+   *is* the communicator, the channel id is assigned by XLA.
+
+2. **Eager** (plain Tensors under the single-controller runtime): an eager
+   jax.Array holds the *global* value — there is no per-rank divergent copy —
+   so cross-replica reductions are sharding transitions, exactly the
+   reference's reshard library ({p,r,s}->{p,r,s},
+   `paddle/phi/core/distributed/auto_parallel/reshard/`): all_reduce of a
+   global value is identity; all_gather of a Shard(0) tensor is a gather to
+   Replicate; reduce_scatter is Replicate->Shard(0). send/recv use an
+   in-process mailbox (one controller owns all ranks).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.tensor import Tensor, apply
+from paddle_tpu.distributed.collective import _get_global_group
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "reduce",
+    "broadcast", "scatter", "reduce_scatter", "alltoall", "alltoall_single",
+    "send", "recv", "isend", "irecv", "barrier", "get_backend",
+    "P2POp", "batch_isend_irecv",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_REDUCE_FNS = {
+    ReduceOp.SUM: lax.psum,
+    ReduceOp.MAX: lax.pmax,
+    ReduceOp.MIN: lax.pmin,
+}
+
+
+def _axis_of(group):
+    g = group or _get_global_group()
+    ax = getattr(g, "axis_name", None)
+    if ax is None:
+        raise ValueError(
+            "in-trace collectives need a Group bound to a mesh axis "
+            "(created by fleet topology or new_group(axis_name=...))")
+    return ax
+
+
+def _is_tracing(x):
+    data = x._data if isinstance(x, Tensor) else x
+    return isinstance(data, jax.core.Tracer)
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _wrap_like(x, data):
+    if isinstance(x, Tensor):
+        out = Tensor(data, stop_gradient=x.stop_gradient)
+        return out
+    return data
+
+
+class _Task:
+    """Completed-on-return task handle (XLA dispatch is already async)."""
+
+    def __init__(self, value=None):
+        self._value = value
+
+    def wait(self):
+        return self._value
+
+    def is_completed(self):
+        return True
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference: communication/all_reduce.py; NCCL impl process_group_nccl.cc:267."""
+    if _is_tracing(tensor):
+        ax = _axis_of(group)
+        fn = _REDUCE_FNS.get(op)
+        if fn is None:
+            if op == ReduceOp.AVG:
+                data = lax.pmean(_raw(tensor), ax)
+            elif op == ReduceOp.PROD:
+                data = jnp.exp(lax.psum(jnp.log(_raw(tensor)), ax))
+            else:
+                raise ValueError(f"unsupported reduce op {op}")
+        else:
+            data = fn(_raw(tensor), ax)
+        out = _wrap_like(tensor, data)
+        if isinstance(tensor, Tensor):
+            tensor._data = out._data if isinstance(out, Tensor) else out
+        return _Task(out)
+    # Eager: values are global; a pending-partial value never escapes an op
+    # under single-controller execution, so this is identity (p->r is fused
+    # into the producing op by XLA).
+    return _Task(tensor)
+
+
+def all_gather(tensor_or_list, tensor=None, group=None, sync_op=True, axis=0):
+    """Reference: communication/all_gather.py.
+
+    In-trace: `lax.all_gather` over the group axis (concatenated form).
+    Eager: gather a Shard tensor to Replicate and split into per-rank chunks.
+    """
+    g = group or _get_global_group()
+    if tensor is None:
+        # functional form: all_gather(tensor) -> concatenated tensor
+        t = tensor_or_list
+        if _is_tracing(t):
+            data = lax.all_gather(_raw(t), _axis_of(g), axis=axis, tiled=True)
+            return _wrap_like(t, data)
+        from paddle_tpu.distributed.api import shard_tensor, get_placements  # noqa
+        return _wrap_like(t, _raw(t))
+    # list form: fills tensor_or_list with per-rank chunks
+    t = tensor
+    if _is_tracing(t):
+        data = lax.all_gather(_raw(t), _axis_of(g), axis=0, tiled=False)
+        chunks = [data[i] for i in range(g.nranks)]
+    else:
+        chunks = [_raw(t) for _ in range(g.nranks)]
+    del tensor_or_list[:]
+    tensor_or_list.extend(_wrap_like(t, c) for c in chunks)
+    return _Task(tensor_or_list)
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = group or _get_global_group()
+    del object_list[:]
+    object_list.extend(obj for _ in range(g.nranks))
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce-to-root == all_reduce under XLA SPMD (no cheaper primitive)."""
+    return all_reduce(tensor, op=op, group=group, sync_op=sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """In-trace: select src rank's value via all_gather+index; eager: identity."""
+    if _is_tracing(tensor):
+        g = group or _get_global_group()
+        src_in_group = g.get_group_rank(src) if src in g.ranks else src
+        data = lax.all_gather(_raw(tensor), _axis_of(g), axis=0)[src_in_group]
+        if isinstance(tensor, Tensor):
+            tensor._data = data
+        return _Task(_wrap_like(tensor, data))
+    return _Task(tensor)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Eager single-controller: take this rank's chunk (rank 0 view)."""
+    g = group or _get_global_group()
+    if tensor_list:
+        src_val = _raw(tensor_list[g.rank if g.rank >= 0 else 0])
+        if isinstance(tensor, Tensor):
+            tensor._data = src_val
+        return _Task(tensor)
+    return _Task(tensor)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Reference: communication/reduce_scatter.py; the ZeRO grad primitive
+    (`fleet/utils/tensor_fusion_helper.py:755`).
+
+    In-trace: `lax.psum_scatter` over the group axis.
+    """
+    g = group or _get_global_group()
+    inp = tensor_or_tensor_list
+    if isinstance(inp, (list, tuple)):
+        inp_arr = jnp.concatenate([_raw(t) for t in inp], axis=0)
+    else:
+        inp_arr = _raw(inp)
+    if isinstance(inp_arr, jax.core.Tracer):
+        data = lax.psum_scatter(inp_arr, _axis_of(g), scatter_dimension=0,
+                                tiled=True)
+    else:
+        # Eager: global value -> this is r->s: keep rank-0 chunk view == full
+        # value split; single-controller keeps the global array sharded.
+        data = inp_arr
+    if isinstance(tensor, Tensor):
+        tensor._data = data
+        return _Task(tensor)
+    return _Task(_wrap_like(tensor, data))
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """In-trace only: lax.all_to_all (the MoE token-exchange primitive,
+    reference `moe_layer.py:117` global_scatter/global_gather)."""
+    g = group or _get_global_group()
+    first = in_tensor_list[0]
+    if _is_tracing(first):
+        stacked = jnp.stack([_raw(t) for t in in_tensor_list], axis=0)
+        out = lax.all_to_all(stacked, _axis_of(g), split_axis=0,
+                             concat_axis=0, tiled=False)
+        chunks = [out[i] for i in range(g.nranks)]
+    else:
+        chunks = [_raw(t) for t in in_tensor_list]
+    del out_tensor_list[:]
+    out_tensor_list.extend(_wrap_like(first, c) for c in chunks)
+    return _Task(out_tensor_list)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    g = group or _get_global_group()
+    data = _raw(in_tensor)
+    if isinstance(data, jax.core.Tracer):
+        data = lax.all_to_all(data, _axis_of(g), split_axis=0, concat_axis=0,
+                              tiled=True)
+    if isinstance(out_tensor, Tensor):
+        out_tensor._data = data
+        return _Task(out_tensor)
+    return _Task(data)
+
+
+# -- p2p: single-controller mailbox (eager) / ppermute (in-trace) -----------
+
+_mailbox = {}
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Eager: in-process mailbox (one controller owns all ranks). In-trace,
+    use `lax.ppermute` via paddle_tpu.distributed.fleet p2p helpers — XLA has
+    no rank-pair send without a permute collective."""
+    _mailbox.setdefault(dst, []).append(_raw(tensor))
+    return _Task(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    from paddle_tpu.distributed.parallel import get_rank
+
+    box = _mailbox.get(get_rank(), [])
+    if box:
+        data = box.pop(0)
+        if isinstance(tensor, Tensor):
+            tensor._data = data
+            return _Task(tensor)
+        return _Task(data)
+    return _Task(tensor)
+
+
+isend = send
+irecv = recv
+
+
+class P2POp:
+    """Reference: p2p_communication.py batched isend/irecv descriptor."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    tasks = []
+    for op in p2p_op_list:
+        tasks.append(op.op(op.tensor, op.peer, op.group))
+    return tasks
+
+
+def barrier(group=None):
+    """Eager: drain dispatch (XLA async queue) — the watchdog sync point."""
+    jax.effects_barrier()
+    return _Task(None)
+
+
+def get_backend(group=None):
+    return "XLA"

@@ -1,0 +1,43 @@
+"""paddle.distributed.fleet — hybrid-parallel training API.
+
+Reference: `python/paddle/distributed/fleet/` (`fleet.py:218` init).
+Usage (identical to the reference):
+
+    import paddle_tpu.distributed.fleet as fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(opt)
+"""
+
+from paddle_tpu.distributed.fleet.base.distributed_strategy import (  # noqa: F401
+    DistributedStrategy,
+)
+from paddle_tpu.distributed.fleet.base.topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup,
+)
+from paddle_tpu.distributed.fleet.fleet import Fleet, fleet as _fleet_singleton  # noqa: F401
+from paddle_tpu.distributed.fleet import meta_parallel  # noqa: F401
+from paddle_tpu.distributed.fleet import recompute as _recompute_mod  # noqa: F401
+from paddle_tpu.distributed.fleet.recompute import recompute  # noqa: F401
+
+# module-level singleton dispatch (reference fleet/__init__.py)
+init = _fleet_singleton.init
+distributed_model = _fleet_singleton.distributed_model
+distributed_optimizer = _fleet_singleton.distributed_optimizer
+worker_index = _fleet_singleton.worker_index
+worker_num = _fleet_singleton.worker_num
+is_first_worker = _fleet_singleton.is_first_worker
+barrier_worker = _fleet_singleton.barrier_worker
+
+
+def get_hybrid_communicate_group():
+    return _fleet_singleton.get_hybrid_communicate_group()
+
+
+def _reset_for_tests():
+    """Reset singleton state (tests only)."""
+    _fleet_singleton._is_initialized = False
+    _fleet_singleton._hcg = None
+    _fleet_singleton._strategy = None

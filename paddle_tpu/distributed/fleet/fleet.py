@@ -1,0 +1,127 @@
+"""The fleet singleton: init / distributed_model / distributed_optimizer.
+
+Reference: `python/paddle/distributed/fleet/fleet.py:218` (init: RoleMaker ->
+init_parallel_env -> HybridCommunicateGroup) and `:1448`
+(distributed_optimizer); model dispatch `fleet/model.py:33,143-188`.
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet.base.distributed_strategy import DistributedStrategy
+from paddle_tpu.distributed.fleet.base.topology import (
+    CommunicateTopology, HybridCommunicateGroup,
+)
+
+__all__ = ["Fleet", "fleet"]
+
+_ORDER_TO_TOPO = {"dp": "data", "pp": "pipe", "sharding": "sharding",
+                  "sep": "sep", "mp": "model"}
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._hcg = None
+        self._strategy = None
+        self._user_defined_strategy = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+        from paddle_tpu.distributed.parallel import init_parallel_env
+
+        init_parallel_env()
+        if strategy is None:
+            strategy = DistributedStrategy()
+        self._strategy = self._user_defined_strategy = strategy
+
+        h = strategy.hybrid_configs
+        import jax
+
+        n = jax.device_count()
+        degrees = {"dp": h["dp_degree"], "mp": h["mp_degree"],
+                   "pp": h["pp_degree"], "sharding": h["sharding_degree"],
+                   "sep": h["sep_degree"]}
+        # infer a single unset degree (reference allows dp_degree=-1)
+        known = 1
+        unset = None
+        for k, v in degrees.items():
+            if v in (-1, None):
+                unset = k
+            else:
+                known *= v
+        if unset is not None:
+            degrees[unset] = max(1, n // known)
+        order = h.get("order") or ["dp", "pp", "sharding", "sep", "mp"]
+        topo = CommunicateTopology(
+            hybrid_group_names=[_ORDER_TO_TOPO[o] for o in order],
+            dims=[degrees[o] for o in order])
+        self._hcg = HybridCommunicateGroup(topo)
+        self._is_initialized = True
+        return self
+
+    # -- accessors (reference fleet.py) -------------------------------------
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        from paddle_tpu.distributed.parallel import get_rank
+
+        return get_rank()
+
+    def worker_num(self):
+        from paddle_tpu.distributed.parallel import get_world_size
+
+        return get_world_size()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_endpoints(self):
+        return [""]
+
+    def barrier_worker(self):
+        from paddle_tpu.distributed.communication import barrier
+
+        barrier()
+
+    # -- model / optimizer wrapping -----------------------------------------
+    def distributed_model(self, model):
+        """Reference fleet/model.py:143-188 dispatch by parallel mode."""
+        if self._hcg is None:
+            raise RuntimeError("call fleet.init() first")
+        from paddle_tpu.distributed.fleet import meta_parallel as mp
+
+        mode = self._hcg.get_parallel_mode()
+        if mode == "data_parallel" :
+            from paddle_tpu.distributed.parallel import DataParallel
+
+            # dp axis mesh slice == full mesh when pure DP
+            return DataParallel(model, mesh=None)
+        if mode == "sharding_parallel":
+            return mp.ShardingParallel(model, self._hcg, self._strategy)
+        if mode == "segment_parallel":
+            return mp.SegmentParallel(model, self._hcg, self._strategy)
+        if mode == "pipeline_parallel":
+            if isinstance(model, mp.PipelineLayer):
+                return mp.PipelineParallel(model, self._hcg, self._strategy)
+            raise TypeError(
+                "pipeline parallel requires the model to be a PipelineLayer")
+        if mode == "tensor_parallel":
+            return mp.TensorParallel(model, self._hcg, self._strategy)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """Reference fleet.py:1448 -> HybridParallelOptimizer."""
+        if strategy is not None:
+            self._strategy = strategy
+        from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer import (
+            HybridParallelOptimizer,
+        )
+
+        if self._hcg is not None:
+            return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+        return optimizer
+
+
+fleet = Fleet()

@@ -1,0 +1,3 @@
+"""fleet.layers: parallel layer library (reference fleet/layers/)."""
+
+from paddle_tpu.distributed.fleet.layers import mpu  # noqa: F401

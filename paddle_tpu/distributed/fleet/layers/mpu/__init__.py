@@ -1,0 +1,10 @@
+"""Model-parallel utility layers (reference fleet/layers/mpu/)."""
+
+from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from paddle_tpu.distributed.fleet.layers.mpu.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+from paddle_tpu.distributed.fleet.layers.mpu import mp_ops  # noqa: F401

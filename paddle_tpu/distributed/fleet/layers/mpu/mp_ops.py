@@ -1,0 +1,126 @@
+"""TP communication primitives.
+
+Reference: `python/paddle/distributed/fleet/layers/mpu/mp_ops.py` —
+`_c_identity` (:77, identity fwd / allreduce grad), `_c_concat` (:122),
+`_mp_allreduce` (:259, allreduce fwd / identity grad), `_c_split`,
+`_c_softmax_with_cross_entropy` (:385).
+
+TPU-native: under single-controller SPMD an eager value is global, so the
+forward allreduce of a partial product is fused into the producing matmul by
+XLA, and the backward identity/allreduce pair is what jax.vjp produces
+naturally for sharded operands. These functions therefore reduce to sharding
+annotations (`with_sharding_constraint`) that pin *where* the collective
+happens when the step is jitted — the semantic content of the reference ops —
+plus real `lax` collectives when called inside shard_map.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce",
+           "_parallel_linear", "_c_lookup_table",
+           "_c_softmax_with_cross_entropy", "sharding_constraint"]
+
+
+def _is_tracing(x):
+    data = x._data if isinstance(x, Tensor) else x
+    return isinstance(data, jax.core.Tracer)
+
+
+def sharding_constraint(t, mesh, placements):
+    """Pin a Tensor's sharding inside a jitted region (GSPMD hint)."""
+    sharding = mesh.sharding(placements, t.ndim)
+    return apply(lambda d: lax.with_sharding_constraint(d, sharding), t,
+                 _name="sharding_constraint")
+
+
+def _c_identity(tensor, group=None, skip_c_identity_dynamic=False):
+    """Identity fwd; grad all-reduced over the mp group (mp_ops.py:77).
+
+    Under GSPMD the grad psum is inserted automatically for operands
+    replicated over 'mp'; eager single-controller grads are already global.
+    """
+    return tensor
+
+
+def _mp_allreduce(tensor, op=None, group=None, use_calc_stream=True,
+                  use_model_parallel=True):
+    """Allreduce fwd; identity grad (mp_ops.py:259).
+
+    Eager: a partial sum never escapes an op (XLA fuses the reduction), so
+    this is identity. In shard_map traces it is a real psum.
+    """
+    if _is_tracing(tensor) and group is not None and group.axis_name:
+        data = lax.psum(tensor._data if isinstance(tensor, Tensor) else tensor,
+                        group.axis_name)
+        return Tensor(data, stop_gradient=getattr(tensor, "stop_gradient", True)) \
+            if isinstance(tensor, Tensor) else data
+    return tensor
+
+
+def _c_split(tensor, group=None):
+    """Split along the last dim, keep this rank's chunk (mp_ops.py).
+
+    Single-controller: re-sharding the last dim over 'mp'."""
+    if group is None or group.mesh is None:
+        return tensor
+    from paddle_tpu.distributed.api import shard_tensor
+    from paddle_tpu.distributed.placement import Replicate, Shard
+
+    mesh = group.mesh
+    placements = [Replicate()] * mesh.ndim
+    placements[mesh.dim_names.index(group.axis_name)] = Shard(tensor.ndim - 1)
+    return shard_tensor(tensor, mesh, placements,
+                        stop_gradient=tensor.stop_gradient)
+
+
+def _c_concat(tensor, group=None):
+    """Gather chunks along the last dim (mp_ops.py:122): reshard to
+    replicated over the mp axis."""
+    if group is None or group.mesh is None:
+        return tensor
+    from paddle_tpu.distributed.api import shard_tensor
+    from paddle_tpu.distributed.placement import Replicate
+
+    mesh = group.mesh
+    return shard_tensor(tensor, mesh, [Replicate()] * mesh.ndim,
+                        stop_gradient=tensor.stop_gradient)
+
+
+def _c_lookup_table(table, index, start_index=0, vocab_size=-1, name=None):
+    """Vocab-parallel lookup (mp_ops.py:310): masked local lookup + psum.
+
+    GSPMD handles a gather from a vocab-sharded table directly; this helper
+    exists for API parity and for explicit shard_map kernels."""
+    from paddle_tpu.nn import functional as F
+
+    return F.embedding(index, table)
+
+
+def _parallel_linear(x, weight, bias, transpose_weight=False, name=None):
+    from paddle_tpu.ops.linalg import matmul
+
+    out = matmul(x, weight, transpose_y=transpose_weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def _c_softmax_with_cross_entropy(logits, label, group=None,
+                                  return_softmax=False, ignore_index=-100):
+    """Parallel CE over class-sharded logits (mp_ops.py:385).
+
+    The reference computes local max/sum + two allreduces. GSPMD derives the
+    same schedule from a class-dim-sharded logits array; we just compute the
+    stable CE globally.
+    """
+    from paddle_tpu.nn.functional.loss import softmax_with_cross_entropy
+
+    return softmax_with_cross_entropy(
+        logits, label, return_softmax=return_softmax,
+        ignore_index=ignore_index)

@@ -1,0 +1,92 @@
+"""Model-parallel RNG state tracker.
+
+Reference: `python/paddle/distributed/fleet/layers/mpu/random.py` —
+`RNGStatesTracker` keeps named RNG states so dropout inside TP regions uses a
+*different* seed per mp rank ('local_seed') while replicated regions use the
+same seed ('global_seed'); `model_parallel_random_seed` derives both.
+
+TPU-native: RNG is counter-based (threefry keys). A "state" is a key; the
+tracker swaps the framework's global key. Under single-controller SPMD a
+dropout over an mp-sharded activation automatically draws independent bits
+per shard (the key is split over positions), so local/global both map to
+plain keys — kept distinct for checkpoint-format parity and for shard_map
+kernels that fold in the axis index.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from paddle_tpu.framework import random as _random
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "determinate_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        self.seeds_.add(seed)
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        cur = _random.get_rng_state()
+        _random.seed(seed)
+        self.states_[name] = _random.get_rng_state()
+        _random.set_rng_state(cur)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = _random.get_rng_state()
+        _random.set_rng_state(self.states_[name])
+        try:
+            yield
+        finally:
+            self.states_[name] = _random.get_rng_state()
+            _random.set_rng_state(orig)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """Derive global/local seeds from the mp rank (reference random.py)."""
+    from paddle_tpu.distributed import fleet
+
+    hcg = fleet.get_hybrid_communicate_group()
+    rank = hcg.get_model_parallel_rank() if hcg else 0
+    if seed:
+        global_seed = seed
+        local_seed = seed * 1024 + rank * 100
+    else:
+        global_seed = 100
+        local_seed = 2048 + rank * 100
+    _RNG_STATE_TRACKER.reset()
+    _RNG_STATE_TRACKER.add(MODEL_PARALLEL_RNG, local_seed)
+    _random.seed(global_seed)
+
+
+def determinate_seed(rng_name):
+    return 0

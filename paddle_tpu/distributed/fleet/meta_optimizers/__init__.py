@@ -1,0 +1,1 @@
+"""fleet.meta_optimizers (reference fleet/meta_optimizers/)."""

@@ -1,0 +1,93 @@
+"""Hybrid-parallel optimizer wrappers.
+
+Reference: `fleet/meta_optimizers/dygraph_optimizer/` —
+HybridParallelOptimizer (grad clip across mp/pp groups),
+HybridParallelGradScaler, DygraphShardingOptimizer (ZeRO stage-1: each rank
+owns a param shard's optimizer states; fused reduce-scatter grad path in
+`fleet/utils/tensor_fusion_helper.py:330,755`).
+
+TPU-native: grads are globally exact under the single controller, so the
+cross-group clip correction disappears; stage-1 sharding = placing optimizer
+accumulators with Shard(0) over the 'sharding' mesh axis — XLA keeps the
+update local to the owning shard and the reference's broadcast-back becomes
+the (lazy) all-gather at next use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.distributed.api import shard_tensor
+from paddle_tpu.distributed.placement import Replicate, Shard
+
+__all__ = ["HybridParallelOptimizer", "HybridParallelGradScaler",
+           "DygraphShardingOptimizer"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=True):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def minimize(self, *a, **k):
+        return self._inner_opt.minimize(*a, **k)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+
+class HybridParallelGradScaler:
+    def __init__(self, scaler, hcg):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_scaler"], name)
+
+
+class DygraphShardingOptimizer(HybridParallelOptimizer):
+    """ZeRO stage-1 (reference dygraph_sharding_optimizer.py): optimizer
+    states sharded over the 'sharding' axis."""
+
+    def __init__(self, optimizer, hcg, strategy=None):
+        super().__init__(optimizer, hcg, strategy)
+        self._shard_states_installed = False
+
+    def step(self):
+        self._inner_opt.step()
+        if not self._shard_states_installed:
+            self._shard_accumulators()
+            self._shard_states_installed = True
+
+    def _shard_accumulators(self):
+        mesh = self._hcg.mesh
+        ax = mesh.dim_names.index("sharding")
+        degree = self._hcg.get_sharding_parallel_world_size()
+        if degree == 1:
+            return
+        accs = getattr(self._inner_opt, "_accumulators", None)
+        if not accs:
+            return
+        import jax
+
+        for key, acc in list(accs.items()):
+            # accumulators are raw jnp arrays keyed by (slot_name, id(param))
+            if hasattr(acc, "ndim") and acc.ndim >= 1 \
+                    and acc.shape[0] % degree == 0:
+                placements = [Replicate()] * mesh.ndim
+                placements[ax] = Shard(0)
+                sharding = mesh.sharding(placements, acc.ndim)
+                accs[key] = jax.device_put(acc, sharding)

@@ -1,0 +1,92 @@
+"""Meta-parallel wrappers (reference `fleet/meta_parallel/`).
+
+TensorParallel / SegmentParallel / ShardingParallel wrap a model for their
+axis; PipelineLayer/PipelineParallel implement stage segmentation + schedule.
+Under the single-controller runtime the wrappers mainly (1) pin parameter
+and input shardings onto the fleet mesh and (2) keep the reference API so
+fleet scripts run unchanged.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (  # noqa: F401
+    LayerDesc, SharedLayerDesc, PipelineLayer,
+)
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel import (  # noqa: F401
+    PipelineParallel, PipelineParallelWithInterleave,
+)
+from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from paddle_tpu.distributed.fleet.layers.mpu.random import (  # noqa: F401
+    RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed,
+)
+
+__all__ = [
+    "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+    "PipelineParallelWithInterleave", "VocabParallelEmbedding",
+    "ColumnParallelLinear", "RowParallelLinear", "ParallelCrossEntropy",
+    "TensorParallel", "SegmentParallel", "ShardingParallel",
+    "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",
+]
+
+
+class _ParallelWrapper:
+    """Shared delegation shell (reference meta_parallel/meta_parallel_base.py)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+
+    def eval(self):
+        self._layers.eval()
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+
+class TensorParallel(_ParallelWrapper):
+    """Reference meta_parallel/tensor_parallel.py:28: broadcasts non-mp
+    params inside the mp group. Single-controller params are born consistent;
+    the TP layers already pinned their mp shardings at construction."""
+
+    pass
+
+
+class SegmentParallel(_ParallelWrapper):
+    """Reference meta_parallel/segment_parallel.py:26: broadcast params over
+    the sep group — consistent by construction here; inputs get their seq dim
+    sharded over 'sep' by the compiled path."""
+
+    pass
+
+
+class ShardingParallel(_ParallelWrapper):
+    """Reference meta_parallel/sharding_parallel.py: the model shell for
+    group-sharded (ZeRO) training; sharding itself lives in the optimizer
+    wrappers (`sharding/group_sharded.py`)."""
+
+    pass

@@ -1,0 +1,135 @@
+"""PipelineParallel trainer (1F1B semantics).
+
+Reference: `python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py`
+— `train_batch` (:940) splits the batch into micro-batches and runs
+`forward_backward_pipeline` (:684): 1F1B warmup/steady/cooldown with p2p
+isend/irecv at stage edges (`pp_utils/p2p_communication.py:573`).
+
+TPU-native: 1F1B exists to bound activation memory *per rank process*; its
+loss/grad math is exactly gradient accumulation over micro-batches. Under a
+single controller the eager trainer runs micro-batches through all stages in
+order and accumulates grads — bit-identical losses to the reference schedule
+— while the *performance* schedule (stage-sharded scan + collective-permute
+over the 'pp' mesh axis, riding ICI) lives in the compiled path
+(`paddle_tpu.parallel.pipeline`), which the driver's multichip dry-run and
+bench use. Activation memory in eager is bounded by recompute_interval.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["PipelineParallel", "PipelineParallelWithInterleave"]
+
+
+class PipelineParallel:
+    def __init__(self, layers, hcg, strategy):
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        pp_cfg = strategy.pipeline_configs
+        self.micro_batch_size = pp_cfg.get("micro_batch_size", 1)
+        self.accumulate_steps = pp_cfg.get("accumulate_steps", 1)
+        self.num_stages = hcg.get_pipe_parallel_world_size()
+        self.stage_id = hcg.get_stage_id()
+        self.total_loss = None
+
+    # -- Layer delegation ----------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def parameters(self, *a, **k):
+        return self._layers.parameters(*a, **k)
+
+    def named_parameters(self, *a, **k):
+        return self._layers.named_parameters(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def train(self):
+        self._layers.train()
+
+    def eval(self):
+        self._layers.eval()
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    # -- the schedule --------------------------------------------------------
+    def _split_micro(self, data):
+        """Split [B, ...] inputs into accumulate_steps micro-batches."""
+        if isinstance(data, (tuple, list)):
+            parts = [self._split_micro(d) for d in data]
+            return list(zip(*parts))
+        if isinstance(data, Tensor):
+            n = self.accumulate_steps
+            b = data.shape[0]
+            if b % n != 0:
+                raise ValueError(
+                    f"batch size {b} not divisible by accumulate_steps {n}")
+            mb = b // n
+            return [data[i * mb:(i + 1) * mb] for i in range(n)]
+        return [data] * self.accumulate_steps
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        """Micro-batch loop == 1F1B loss/grad math (reference :684)."""
+        inputs, labels = data
+        micro_inputs = self._split_micro(inputs)
+        micro_labels = self._split_micro(labels)
+        total = None
+        for mi, ml in zip(micro_inputs, micro_labels):
+            out = self._layers(mi) if not isinstance(mi, (tuple, list)) \
+                else self._layers(*mi)
+            loss_fn = getattr(self._layers, "_loss_fn", None)
+            if loss_fn is None:
+                raise RuntimeError("PipelineLayer needs loss_fn for train_batch")
+            loss = loss_fn(out, ml)
+            loss = loss / self.accumulate_steps
+            if scaler is not None:
+                scaled = scaler.scale(loss)
+                scaled.backward()
+            else:
+                loss.backward()
+            total = loss if total is None else total + loss.detach()
+        self.total_loss = total
+        return total
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Reference :940: run schedule then step."""
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss=True):
+        self._layers.eval()
+        from paddle_tpu.core.tensor import no_grad
+
+        inputs, labels = data
+        with no_grad():
+            out = self._layers(inputs) if not isinstance(inputs, (tuple, list)) \
+                else self._layers(*inputs)
+            if compute_loss:
+                return self._layers._loss_fn(out, labels)
+            return out
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved (virtual) pipeline, reference :1308 — same math under the
+    single controller; kept as a named mode for schedule selection in the
+    compiled path."""
+
+    pass

@@ -1,0 +1,147 @@
+"""PipelineLayer: declarative stage segmentation.
+
+Reference: `python/paddle/distributed/fleet/meta_parallel/parallel_layers/pp_layers.py`
+— LayerDesc (:57), SharedLayerDesc (:77), PipelineLayer (:264) which cuts the
+layer list into pp_degree segments (uniform or by seg_method) and
+instantiates only the local stage's layers.
+
+TPU-native: the single controller owns every stage, so PipelineLayer
+instantiates *all* segments and records the stage boundaries. The eager
+trainer runs them in order (mathematically identical to 1F1B — see
+pipeline_parallel.py); the compiled trainer consumes `self.segments` to
+build the stage-sharded scan/ppermute pipeline over the 'pp' mesh axis.
+"""
+
+from __future__ import annotations
+
+import re
+
+from paddle_tpu import nn
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_class, *inputs, **kwargs):
+        self.layer_class = layer_class
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_class, nn.Layer):
+            raise TypeError(f"{layer_class} must be a paddle.nn.Layer subclass")
+
+    def build_layer(self):
+        return self.layer_class(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_class.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer appearing in several stages (reference :77, e.g.
+    tied input/output embeddings)."""
+
+    def __init__(self, key, layer_class, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_class, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(nn.Layer):
+    """Reference pp_layers.py:264."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._topo = topology
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+
+        # build all layers (single controller owns all stages)
+        self.run_function = []
+        self._shared = {}
+        built = nn.LayerList()
+        for i, d in enumerate(self._layers_desc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name not in self._shared:
+                    self._shared[d.layer_name] = d.build_layer()
+                layer = self._shared[d.layer_name]
+                fwd = d.forward_func
+                if fwd is not None:
+                    self.run_function.append(
+                        (lambda l, f: (lambda *x: f(l, *x)))(layer, fwd))
+                else:
+                    self.run_function.append(layer)
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                layer = d.build_layer()
+                self.run_function.append(layer)
+                built.append(layer)
+            elif isinstance(d, nn.Layer):
+                self.run_function.append(d)
+                built.append(d)
+            elif callable(d):
+                self.run_function.append(d)
+            else:
+                raise TypeError(f"unsupported layer desc {d!r}")
+        self._built_layers = built
+
+        self.segments = self._segment(seg_method)
+
+    def _segment(self, seg_method):
+        """Cut run_function into num_stages segments: 'uniform' or
+        'layer:<ClassName>' (reference SegmentLayers)."""
+        n = len(self.run_function)
+        k = self._num_stages
+        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
+            cls_name = seg_method.split(":", 1)[1]
+            marks = [i for i, d in enumerate(self._layers_desc)
+                     if (isinstance(d, LayerDesc) and
+                         d.layer_class.__name__ == cls_name)
+                     or type(d).__name__ == cls_name]
+            if len(marks) >= k:
+                per = len(marks) // k
+                cuts = [0] + [marks[per * i] for i in range(1, k)] + [n]
+            else:
+                cuts = self._uniform_cuts(n, k)
+        else:
+            cuts = self._uniform_cuts(n, k)
+        return [(cuts[i], cuts[i + 1]) for i in range(k)]
+
+    @staticmethod
+    def _uniform_cuts(n, k):
+        base, rem = divmod(n, k)
+        cuts = [0]
+        for i in range(k):
+            cuts.append(cuts[-1] + base + (1 if i < rem else 0))
+        return cuts
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_forward(self, stage_id, *args):
+        start, end = self.segments[stage_id]
+        x = args
+        for i in range(start, end):
+            fn = self.run_function[i]
+            x = fn(*x) if isinstance(x, tuple) else fn(x)
+        return x
+
+    def forward(self, *args):
+        x = args
+        for i, fn in enumerate(self.run_function):
+            if self._recompute_interval > 0 and i % self._recompute_interval == 0 \
+                    and i > 0:
+                from paddle_tpu.distributed.fleet.recompute import recompute
+
+                x = (recompute(fn, *x) if isinstance(x, tuple)
+                     else recompute(fn, x))
+            else:
+                x = fn(*x) if isinstance(x, tuple) else fn(x)
+        return x

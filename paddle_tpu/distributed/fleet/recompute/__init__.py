@@ -1,0 +1,122 @@
+"""Activation recomputation (checkpointing).
+
+Reference: `python/paddle/distributed/fleet/recompute/recompute.py:128`
+(RecomputeFunction PyLayer: drop activations in forward, replay forward with
+saved RNG state in backward) and the user API at `:463`.
+
+TPU-native: two paths share this API —
+- eager: a PyLayer that re-runs the function under the tape in backward
+  (RNG states restored via the mpu tracker), same as the reference;
+- compiled: `paddle_tpu.jit` functionalization maps recompute-wrapped calls
+  to `jax.checkpoint` (XLA rematerialization), the idiomatic TPU form.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.autograd import PyLayer
+from paddle_tpu.core import tensor as _tmod
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.core.backward import run_backward
+from paddle_tpu.framework import random as _random
+
+__all__ = ["recompute", "RecomputeFunction", "recompute_sequential"]
+
+
+class RecomputeFunction(PyLayer):
+    _force_record = True  # params enter via closure, not tensor args
+
+    @staticmethod
+    def forward(ctx, run_function, preserve_rng_state, *args):
+        ctx.run_function = run_function
+        ctx.preserve_rng_state = preserve_rng_state
+        ctx.inputs = args
+        if preserve_rng_state:
+            ctx.fw_rng_state = _random.get_rng_state()
+            from paddle_tpu.distributed.fleet.layers.mpu.random import (
+                get_rng_state_tracker,
+            )
+
+            ctx.fw_tracker_states = get_rng_state_tracker().get_states_tracker()
+        outputs = run_function(*args)
+        return outputs
+
+    @staticmethod
+    def backward(ctx, *grads):
+        # replay forward with grad enabled under the saved RNG state
+        detached = []
+        for a in ctx.inputs:
+            if isinstance(a, Tensor):
+                d = Tensor(a._data, stop_gradient=a.stop_gradient)
+                detached.append(d)
+            else:
+                detached.append(a)
+
+        rng_ctx = None
+        if ctx.preserve_rng_state:
+            cur = _random.get_rng_state()
+            _random.set_rng_state(ctx.fw_rng_state)
+            from paddle_tpu.distributed.fleet.layers.mpu.random import (
+                get_rng_state_tracker,
+            )
+
+            tracker = get_rng_state_tracker()
+            cur_tracker = tracker.get_states_tracker()
+            tracker.set_states_tracker(ctx.fw_tracker_states)
+
+        prev = _tmod.is_grad_enabled()
+        _tmod.set_grad_enabled(True)
+        try:
+            outputs = ctx.run_function(*detached)
+        finally:
+            _tmod.set_grad_enabled(prev)
+            if ctx.preserve_rng_state:
+                _random.set_rng_state(cur)
+                tracker.set_states_tracker(cur_tracker)
+
+        outs = list(outputs) if isinstance(outputs, (tuple, list)) else [outputs]
+        grads = list(grads)
+        # backprop through the replayed subgraph
+        seeds, gseeds = [], []
+        for o, g in zip(outs, grads):
+            if isinstance(o, Tensor) and not o.stop_gradient:
+                seeds.append(o)
+                gseeds.append(g)
+        tensor_inputs = [d for d in detached if isinstance(d, Tensor)]
+        for t in tensor_inputs:
+            t.grad = None
+        run_backward(seeds, gseeds, retain_graph=False)
+        # one grad slot per Tensor input (PyLayer zips node.inputs <-> grads)
+        return tuple(t.grad if t.grad is not None else None
+                     for t in tensor_inputs) or (None,)
+
+
+def recompute(function, *args, **kwargs):
+    """Reference recompute.py:463. kwargs: use_reentrant, preserve_rng_state."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", True)
+    if kwargs:
+        raise ValueError(f"unexpected kwargs {list(kwargs)}")
+    if not _tmod.is_grad_enabled():
+        return function(*args)
+    # PyLayer.apply routes only Tensor args into autograd; run_function and
+    # flags ride along as non-tensor args.
+    return RecomputeFunction.apply(function, preserve, *args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Reference incubate recompute_sequential: chunk a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    funcs = list(functions)
+    chunk = max(1, len(funcs) // max(1, segments))
+    out = args
+    for i in range(0, len(funcs), chunk):
+        seg = funcs[i:i + chunk]
+
+        def run_seg(*xs, _seg=seg):
+            y = xs
+            for f in _seg:
+                y = f(*y) if isinstance(y, tuple) else f(y)
+            return y
+
+        out = recompute(run_seg, *(out if isinstance(out, tuple) else (out,)), **kwargs)
+    return out

@@ -1,0 +1,4 @@
+"""fleet.utils (reference fleet/utils/)."""
+
+from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils  # noqa: F401
+from paddle_tpu.distributed.fleet.recompute import recompute  # noqa: F401

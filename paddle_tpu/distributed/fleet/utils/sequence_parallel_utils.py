@@ -1,0 +1,121 @@
+"""Megatron-style sequence parallelism utilities.
+
+Reference: `python/paddle/distributed/fleet/utils/sequence_parallel_utils.py`
+— ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers (:85-147),
+ColumnSequenceParallelLinear (:429), RowSequenceParallelLinear (:564):
+activations sharded along the *sequence* dim across the TP group between the
+attention/MLP blocks, so LayerNorm/dropout compute on seq/tp_degree tokens.
+
+TPU-native: sequence sharding is just a sharding constraint on the seq dim
+over the 'mp' axis; XLA places the all-gather before the column matmul and
+the reduce-scatter after the row matmul — exactly the reference's manual
+schedule, but fused and overlapped by the compiler. The PyLayer forms below
+exist so eager code (and tests) can spell the transitions explicitly.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.distributed.api import shard_tensor
+from paddle_tpu.distributed.placement import Replicate, Shard
+from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear,
+)
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather",
+    "mark_as_sequence_parallel_parameter",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "create_fused_allreduce_gradient_hooks",
+]
+
+
+def _mp_mesh():
+    from paddle_tpu.distributed import fleet
+
+    hcg = fleet.get_hybrid_communicate_group()
+    if hcg is None:
+        return None, -1
+    return hcg.mesh, hcg.mesh.dim_names.index("mp")
+
+
+def _seq_placements(mesh, mp_idx, seq_dim):
+    placements = [Replicate()] * mesh.ndim
+    placements[mp_idx] = Shard(seq_dim)
+    return placements
+
+
+def scatter(x, seq_dim=0):
+    """Split the seq dim across the TP group (reference :85 ScatterOp fwd)."""
+    mesh, mp_idx = _mp_mesh()
+    if mesh is None:
+        return x
+    return shard_tensor(x, mesh, _seq_placements(mesh, mp_idx, seq_dim),
+                        stop_gradient=x.stop_gradient)
+
+
+def all_gather(x, seq_dim=0):
+    """Gather the seq dim back (reference :103 GatherOp fwd)."""
+    mesh, mp_idx = _mp_mesh()
+    if mesh is None:
+        return x
+    return shard_tensor(x, mesh, [Replicate()] * mesh.ndim,
+                        stop_gradient=x.stop_gradient)
+
+
+class ScatterOp:
+    """seq split fwd / all-gather bwd — the transition into an SP region."""
+
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return scatter(x, seq_dim)
+
+
+class GatherOp:
+    """all-gather fwd / seq split bwd — the transition out of an SP region."""
+
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return all_gather(x, seq_dim)
+
+
+class AllGatherOp:
+    """all-gather fwd / reduce-scatter bwd (before ColumnSPLinear)."""
+
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return all_gather(x, seq_dim)
+
+
+class ReduceScatterOp:
+    """reduce-scatter fwd / all-gather bwd (after RowSPLinear)."""
+
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return scatter(x, seq_dim)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True if not hasattr(param, "__slots__") else None
+
+
+def create_fused_allreduce_gradient_hooks(model, accumulation_steps=1):
+    """Reference :156-217: SP params need grad allreduce over mp. Grads are
+    globally exact under the single controller — nothing to register."""
+    return []
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Reference :429: AllGather(seq) -> column-parallel matmul."""
+
+    def forward(self, x):
+        x = AllGatherOp.apply(x, seq_dim=1 if x.ndim >= 3 else 0)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Reference :564: row-parallel matmul -> ReduceScatter(seq)."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return ReduceScatterOp.apply(out, seq_dim=1 if out.ndim >= 3 else 0)

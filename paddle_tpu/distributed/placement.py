@@ -1,0 +1,130 @@
+"""Tensor placements: Shard / Replicate / Partial.
+
+Reference surface: `paddle/phi/core/distributed/auto_parallel/placement_types.h`
+and the Python mirror `python/paddle/distributed/auto_parallel/placement_type.py`.
+
+TPU-native design: a placement list (one entry per mesh dim) compiles directly
+to a `jax.sharding.PartitionSpec` (one entry per *tensor* dim). The reference's
+121 SPMD rules + reshard function library (`paddle/phi/infermeta/spmd_rules/`,
+`paddle/phi/core/distributed/auto_parallel/reshard/`) collapse into GSPMD
+sharding propagation: we annotate, XLA propagates and inserts collectives.
+
+`Partial` exists transiently in the reference (a produced-but-not-yet-reduced
+allreduce input, `placement_types.h` kPartial). Under a single-controller JAX
+runtime an eager op over sharded operands always yields the *full* result
+(XLA inserts the psum when jitted), so Partial never materializes in user
+code; it is kept for API parity and for spelling reshard(p->r) explicitly.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial", "to_partition_spec",
+           "from_partition_spec"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Shard along tensor dimension `dim` over this mesh dimension."""
+
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def get_dim(self):
+        return self.dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("Shard", self.dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending reduction over this mesh dimension (reference kPartial)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self.reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+
+def to_partition_spec(placements, ndim, dim_names):
+    """[placement per mesh-dim] -> PartitionSpec (entry per tensor-dim).
+
+    Multiple mesh dims sharding the same tensor dim are ordered by mesh-dim
+    index (reference: `TensorDistAttr.dims_mapping` semantics,
+    `paddle/phi/core/distributed/auto_parallel/dist_attr.h`).
+    """
+    per_tensor_dim = [[] for _ in range(ndim)]
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            if pl.dim >= ndim or pl.dim < -ndim:
+                raise ValueError(
+                    f"Shard(dim={pl.dim}) out of range for ndim={ndim}")
+            per_tensor_dim[pl.dim % ndim].append(dim_names[mesh_dim])
+    entries = []
+    for axes in per_tensor_dim:
+        if not axes:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def from_partition_spec(spec, mesh_ndim, dim_names):
+    """PartitionSpec -> [placement per mesh-dim] (inverse of to_partition_spec)."""
+    placements = [Replicate() for _ in range(mesh_ndim)]
+    name_to_mesh_dim = {n: i for i, n in enumerate(dim_names)}
+    for tdim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            placements[name_to_mesh_dim[ax]] = Shard(tdim)
+    return placements

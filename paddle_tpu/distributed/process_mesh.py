@@ -1,0 +1,130 @@
+"""ProcessMesh: the logical device mesh.
+
+Reference: `paddle/phi/core/distributed/auto_parallel/process_mesh.h` and
+`python/paddle/distributed/auto_parallel/process_mesh.py`.
+
+TPU-native design: a ProcessMesh is a thin, picklable description (shape +
+dim_names + process ids) that lazily materializes a `jax.sharding.Mesh` over
+real devices. In the reference a "process" is an MPI-style rank; here a
+process id indexes `jax.devices()` — the single-controller runtime drives all
+chips, and multi-host runs get their device list from
+`jax.distributed.initialize` (see `paddle_tpu.distributed.parallel`).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from paddle_tpu.distributed.placement import to_partition_spec
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh", "init_mesh"]
+
+_state = threading.local()
+_global_mesh = None
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, shape=None, process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh, dtype=np.int64)
+        else:
+            arr = np.asarray(process_ids, dtype=np.int64).reshape(shape)
+        self._mesh = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} does not match mesh ndim {arr.ndim}")
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    # -- reference API parity (process_mesh.py properties) ------------------
+    @property
+    def shape(self):
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return self._mesh.flatten().tolist()
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    def get_dim_size(self, dim_name):
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        axis = self._dim_names.index(dim_name)
+        coords = np.argwhere(self._mesh == process_id)
+        return int(coords[0][axis]) if len(coords) else -1
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    def __deepcopy__(self, memo):
+        return ProcessMesh(self._mesh.copy(), list(self._dim_names))
+
+    # -- TPU-native: materialize a jax Mesh ---------------------------------
+    def jax_mesh(self):
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            if self._mesh.size > len(devices):
+                raise RuntimeError(
+                    f"ProcessMesh needs {self._mesh.size} devices, have "
+                    f"{len(devices)} (set XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count=N for CPU tests)")
+            dev_arr = np.empty(self._mesh.shape, dtype=object)
+            for idx, pid in np.ndenumerate(self._mesh):
+                dev_arr[idx] = devices[int(pid)]
+            self._jax_mesh = Mesh(dev_arr, axis_names=tuple(self._dim_names))
+        return self._jax_mesh
+
+    def sharding(self, placements, ndim):
+        """NamedSharding for a tensor of rank `ndim` with `placements`."""
+        spec = to_partition_spec(placements, ndim, self._dim_names)
+        return NamedSharding(self.jax_mesh(), spec)
+
+
+def set_mesh(mesh):
+    """Set the global mesh (reference `auto_parallel/api.py` set_mesh)."""
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh():
+    return _global_mesh
+
+
+def init_mesh(dim_names, shape=None):
+    """Convenience: build a ProcessMesh over all visible devices."""
+    n = jax.device_count()
+    if shape is None:
+        shape = [n]
+    size = int(np.prod(shape))
+    if size != n and -1 not in shape:
+        raise ValueError(f"mesh shape {shape} != device count {n}")
+    mesh = ProcessMesh(np.arange(size).reshape(shape), dim_names)
+    set_mesh(mesh)
+    return mesh

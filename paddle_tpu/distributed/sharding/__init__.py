@@ -1,0 +1,70 @@
+"""User API for group-sharded (ZeRO) training.
+
+Reference: `python/paddle/distributed/sharding/group_sharded.py:50` —
+`group_sharded_parallel(model, optimizer, level='os'|'os_g'|'p_g_os', ...)`
+and `save_group_sharded_model`.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.distributed.fleet.meta_parallel.sharding import (
+    GroupShardedOptimizerStage2, GroupShardedStage2, GroupShardedStage3,
+)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def _default_group():
+    import jax
+    import numpy as np
+
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.collective import new_group
+    from paddle_tpu.distributed.process_mesh import ProcessMesh
+
+    hcg = fleet.get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_sharding_parallel_group()
+    n = jax.device_count()
+    mesh = ProcessMesh(np.arange(n), ["sharding"])
+    return new_group(list(range(n)), axis_name="sharding", mesh=mesh)
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    """Reference group_sharded.py:50. level: 'os' (stage1), 'os_g' (stage2),
+    'p_g_os' (stage3)."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError("level must be one of 'os', 'os_g', 'p_g_os'")
+    group = group or _default_group()
+    opt = GroupShardedOptimizerStage2(
+        params=list(model.parameters()), optim=optimizer, group=group,
+        offload=offload)
+    if level == "os":
+        return model, opt, scaler
+    if level == "os_g":
+        model = GroupShardedStage2(model, opt, group=group,
+                                   sync_buffers=sync_buffers,
+                                   buffer_max_size=buffer_max_size)
+    else:
+        model = GroupShardedStage3(model, optimizer=opt, group=group,
+                                   sync_comm=sync_comm,
+                                   segment_size=segment_size)
+    return model, opt, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Reference group_sharded.py save_group_sharded_model."""
+    import os
+
+    import paddle_tpu as paddle
+
+    if isinstance(model, GroupShardedStage3):
+        model.get_all_parameters()
+    layer = getattr(model, "_layers", model)
+    os.makedirs(output, exist_ok=True)
+    paddle.save(layer.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        paddle.save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))
