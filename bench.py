@@ -1,0 +1,123 @@
+"""Benchmark: compiled Llama pretrain step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no in-repo benchmark numbers (BASELINE.md), so
+vs_baseline is 1.0 by definition at the measured value; the driver's
+BENCH_r{N}.json history is the cross-round comparison.
+
+Each candidate config runs in a subprocess: an OOM'd attempt would otherwise
+pin device buffers via traceback frames and poison smaller fallbacks.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+
+def _bench(cfg_kw, batch, seq, steps=8, warmup=2):
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.models import llama_functional as lf
+    from paddle_tpu.distributed.hybrid_engine import adamw_init, adamw_update
+
+    cfg = LlamaConfig(**cfg_kw)
+    args = lf.LlamaArgs.from_config(cfg)
+    key = jax.random.key(0)
+    params = jax.jit(lambda k: lf.init_params(args, k, jnp.bfloat16))(key)
+    opt = jax.jit(adamw_init)(params)
+
+    def train_step(params, opt, ids, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: lf.forward_and_loss(p, ids, labels, args, remat=True))(params)
+        params, opt = adamw_update(params, grads, opt, lr=1e-4)
+        return loss, params, opt
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, args.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, args.vocab_size, (batch, seq)), jnp.int32)
+
+    for _ in range(warmup):
+        loss, params, opt = step(params, opt, ids, labels)
+    # device->host readback is the only reliable fence on the axon tunnel
+    # (block_until_ready returns early there)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt = step(params, opt, ids, labels)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return batch * seq * steps / dt
+
+
+def _candidate_configs(backend):
+    if backend == "tpu":
+        return [
+            # ~0.94B params, fits a v5e (16G); larger chips just go faster
+            (dict(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+                  num_hidden_layers=16, num_attention_heads=16,
+                  max_position_embeddings=1024), 8, 1024),
+            (dict(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+                  num_hidden_layers=8, num_attention_heads=8,
+                  max_position_embeddings=1024), 8, 1024),
+        ]
+    return [
+        (dict(vocab_size=1024, hidden_size=256, intermediate_size=704,
+              num_hidden_layers=4, num_attention_heads=4,
+              max_position_embeddings=256), 4, 256),
+    ]
+
+
+def _run_single(spec_json):
+    spec = json.loads(spec_json)
+    tps = _bench(spec["cfg"], spec["batch"], spec["seq"])
+    print("BENCH_RESULT " + json.dumps({"tps": tps}))
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    for cfg_kw, batch, seq in _candidate_configs(backend):
+        spec = json.dumps({"cfg": cfg_kw, "batch": batch, "seq": seq})
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--single", spec],
+                capture_output=True, text=True, timeout=1800,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            for line in out.stdout.splitlines():
+                if line.startswith("BENCH_RESULT "):
+                    tps = json.loads(line[len("BENCH_RESULT "):])["tps"]
+                    print(json.dumps({
+                        "metric": f"llama_train_tokens_per_sec_{backend}"
+                                  f"_h{cfg_kw['hidden_size']}"
+                                  f"_l{cfg_kw['num_hidden_layers']}"
+                                  f"_s{seq}_b{batch}_bf16",
+                        "value": round(tps, 1),
+                        "unit": "tokens/sec/chip",
+                        "vs_baseline": 1.0,
+                    }))
+                    return 0
+            print(f"bench config h{cfg_kw['hidden_size']} failed:\n"
+                  f"{out.stderr[-2000:]}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"bench config h{cfg_kw['hidden_size']} timed out",
+                  file=sys.stderr)
+    print(json.dumps({"metric": "llama_train_tokens_per_sec", "value": 0,
+                      "unit": "tokens/sec/chip", "vs_baseline": 0.0}))
+    return 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 3 and sys.argv[1] == "--single":
+        _run_single(sys.argv[2])
+    else:
+        sys.exit(main())

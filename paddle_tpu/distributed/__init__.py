@@ -37,25 +37,19 @@ from paddle_tpu.distributed.parallel import (  # noqa: F401
 )
 
 
+import importlib as _importlib
+
+_LAZY_SUBMODULES = ("fleet", "checkpoint", "launch", "sharding", "utils",
+                    "auto_parallel", "rpc")
+
+
 def __getattr__(name):
-    if name == "fleet":
-        from paddle_tpu.distributed import fleet
-
-        return fleet
-    if name == "checkpoint":
-        from paddle_tpu.distributed import checkpoint
-
-        return checkpoint
-    if name == "launch":
-        from paddle_tpu.distributed import launch
-
-        return launch
-    if name == "sharding":
-        from paddle_tpu.distributed import sharding
-
-        return sharding
-    if name == "utils":
-        from paddle_tpu.distributed import utils
-
-        return utils
+    if name in _LAZY_SUBMODULES:
+        try:
+            mod = _importlib.import_module(f"paddle_tpu.distributed.{name}")
+        except ModuleNotFoundError as e:
+            raise AttributeError(
+                f"module 'paddle_tpu.distributed' has no attribute {name!r}") from e
+        globals()[name] = mod
+        return mod
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

@@ -181,6 +181,9 @@ class Model:
     def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
         logs = {}
         self._reset_metrics()
+        # sample-weighted running mean, matching the reference ProgBarLogger's
+        # averaged loss (reference python/paddle/hapi/model.py _run_one_epoch)
+        loss_sum, seen = 0.0, 0
         for step, data in enumerate(loader):
             if num_iters is not None and step >= num_iters:
                 break
@@ -195,10 +198,15 @@ class Model:
             else:
                 self.predict_batch(ins)
                 losses, metrics = [np.zeros(1)], []
-            logs["loss"] = float(np.asarray(losses[0]).reshape(-1)[0])
-            logs["step"] = step
             batch0 = ins[0]
-            logs["batch_size"] = batch0.shape[0] if hasattr(batch0, "shape") else 1
+            bsz = batch0.shape[0] if hasattr(batch0, "shape") else 1
+            batch_loss = float(np.asarray(losses[0]).reshape(-1)[0])
+            loss_sum += batch_loss * bsz
+            seen += bsz
+            logs["loss"] = loss_sum / max(seen, 1)
+            logs["batch_loss"] = batch_loss
+            logs["step"] = step
+            logs["batch_size"] = bsz
             self._merge_metric_logs(logs)
             cbks.on_batch_end(mode, step, logs)
         return logs

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels — the hot fused ops the reference implements in CUDA
+(`paddle/phi/kernels/gpu/flash_attn_kernel.cu`, `paddle/phi/kernels/fusion/gpu/`).
+"""

@@ -1,0 +1,142 @@
+"""Flash attention forward as a Pallas TPU kernel.
+
+Reference counterpart: `paddle/phi/kernels/gpu/flash_attn_kernel.cu` (CUDA
+flash-attn v2). TPU-native design: online-softmax blockwise attention tiled
+for VMEM — q is blocked over the grid, k/v stream through a fori_loop with a
+running (max, sum, acc) triple; the causal variant bounds the k loop at the
+query block's diagonal so the MXU never touches fully-masked tiles.
+
+Backward currently recomputes through the XLA attention vjp (correct, fused
+by XLA); a Pallas backward kernel is a planned optimisation.
+
+Layout: paddle's [batch, seq, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
+                block_k, seq_q, seq_k):
+    # block shapes: q/o [1, block_q, d]; k/v [1, seq_k, d]
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [bq, d] native dtype: bf16 inputs stay on the fast MXU path
+    d = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    q_start = qi * block_q
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [bq, bk] f32 acc
+        if causal:
+            # offset diagonal for cross-length (sq != sk): query i may see
+            # keys j <= i + (sk - sq), matching tril(k=sk-sq) in the fallback
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                          (block_q, block_k), 1)
+            s = jnp.where(rows + (seq_k - seq_q) >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    if causal:
+        # only k blocks at or left of this q block's (offset) diagonal
+        diag_end = q_start + block_q + (seq_k - seq_q)
+        num_kb = jnp.minimum((diag_end + block_k - 1) // block_k,
+                             seq_k // block_k)
+    else:
+        num_kb = seq_k // block_k
+    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhsd(q, k, v, causal, sm_scale, block_q=256, block_k=256,
+                    interpret=False):
+    """q,k,v: [BH, S, D] -> out [BH, S, D]."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                             block_q=block_q, block_k=block_k, seq_q=sq,
+                             seq_k=sk)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _sdpa_xla(q, k, v, causal, sm_scale):
+    """Reference attention in [b, s, h, d]; used for the backward pass."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, sm_scale, interpret):
+    b, sq, h, d = q.shape
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, k.shape[1], d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, v.shape[1], d)
+    out = _flash_fwd_bhsd(qt, kt, vt, causal, sm_scale, interpret=interpret)
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+
+
+def _fwd(q, k, v, causal, sm_scale, interpret):
+    return _flash_attention(q, k, v, causal, sm_scale, interpret), (q, k, v)
+
+
+def _bwd(causal, sm_scale, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: _sdpa_xla(q, k, v, causal, sm_scale),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None, interpret=False):
+    """q,k,v: [batch, seq, heads, head_dim] (paddle layout)."""
+    d = q.shape[-1]
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    sq, sk = q.shape[1], k.shape[1]
+    if sq % 128 != 0 or sk % 128 != 0:
+        # unpadded tails: fall back to the fused XLA path
+        return _sdpa_xla(q, k, v, causal, sm_scale)
+    return _flash_attention(q, k, v, causal, sm_scale, interpret)

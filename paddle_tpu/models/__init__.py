@@ -1,0 +1,21 @@
+"""Model zoo: flagship transformer families for the TPU framework.
+
+Reference parity targets:
+  - Llama decoder family (reference:
+    `test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py`,
+    the hybrid-parallel Llama used by the north-star config 4).
+  - Vision models live in `paddle_tpu.vision.models`.
+"""
+
+from paddle_tpu.models.llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaRMSNorm,
+    LlamaRotaryEmbedding,
+    LlamaAttention,
+    LlamaMLP,
+    LlamaDecoderLayer,
+    LlamaModel,
+    LlamaForCausalLM,
+    LlamaPretrainingCriterion,
+)
+from paddle_tpu.models import llama_functional  # noqa: F401

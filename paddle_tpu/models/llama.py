@@ -1,0 +1,387 @@
+"""Llama decoder-only transformer, eager nn.Layer form.
+
+Behavioral reference: the hybrid-parallel Llama the reference trains for its
+north-star config (`test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py`;
+TP layers `python/paddle/distributed/fleet/layers/mpu/mp_layers.py:49,336,543`).
+
+TPU-native design decisions:
+  - attention runs through `nn.functional.flash_attention` which dispatches to
+    a Pallas kernel on TPU (reference's `flash_attn_kernel.cu` counterpart);
+  - weights are stored [in, out] so matmuls hit the MXU without transposes;
+  - tensor parallelism: when fleet is initialised with mp>1 the q/k/v/o and
+    MLP projections become Column/RowParallelLinear — sharded over the 'mp'
+    mesh axis, with XLA inserting the collectives (GSPMD) instead of the
+    reference's hand-written _mp_allreduce (`mp_ops.py:259`);
+  - RoPE is applied in float32 for numerical parity with the reference's
+    fused rope kernel (`paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu`).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor, apply
+from paddle_tpu.nn import functional as F
+import importlib
+
+flash_attn_mod = importlib.import_module("paddle_tpu.nn.functional.flash_attention")
+
+__all__ = [
+    "LlamaConfig",
+    "LlamaRMSNorm",
+    "LlamaRotaryEmbedding",
+    "LlamaAttention",
+    "LlamaMLP",
+    "LlamaDecoderLayer",
+    "LlamaModel",
+    "LlamaForCausalLM",
+    "LlamaPretrainingCriterion",
+]
+
+
+class LlamaConfig:
+    """Hyperparameters (mirrors the reference test model's config surface)."""
+
+    def __init__(
+        self,
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=11008,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=None,
+        max_position_embeddings=4096,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        use_flash_attention=True,
+        sequence_parallel=False,
+        recompute=False,
+        tie_word_embeddings=False,
+        dtype="float32",
+    ):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        self.max_position_embeddings = max_position_embeddings
+        self.rms_norm_eps = rms_norm_eps
+        self.rope_theta = rope_theta
+        self.use_flash_attention = use_flash_attention
+        self.sequence_parallel = sequence_parallel
+        self.recompute = recompute
+        self.tie_word_embeddings = tie_word_embeddings
+        self.dtype = dtype
+
+    @staticmethod
+    def llama2_7b(**kw):
+        return LlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=32, num_attention_heads=32, **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        """Small config for tests/benchmarks."""
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("hidden_size", 128)
+        kw.setdefault("intermediate_size", 352)
+        kw.setdefault("num_hidden_layers", 4)
+        kw.setdefault("num_attention_heads", 4)
+        kw.setdefault("max_position_embeddings", 256)
+        return LlamaConfig(**kw)
+
+
+def _mp_enabled():
+    from paddle_tpu.distributed import fleet
+
+    hcg = fleet.get_hybrid_communicate_group()
+    return hcg is not None and hcg.get_model_parallel_world_size() > 1
+
+
+class LlamaRMSNorm(nn.Layer):
+    """RMS norm in fp32 accumulation (reference model's fused_rms_norm path)."""
+
+    def __init__(self, hidden_size, eps=1e-6):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [hidden_size], default_initializer=nn.initializer.Constant(1.0))
+        self.variance_epsilon = eps
+
+    def forward(self, x):
+        eps = self.variance_epsilon
+
+        def fn(h, w):
+            dt = h.dtype
+            h32 = h.astype(jnp.float32)
+            var = jnp.mean(h32 * h32, axis=-1, keepdims=True)
+            return (h32 * (1.0 / jnp.sqrt(var + eps))).astype(dt) * w
+
+        return apply(fn, x, self.weight, _name="rms_norm")
+
+
+# single source of truth for RoPE math: the functional core
+from paddle_tpu.models.llama_functional import (
+    apply_rope as _apply_rope, rope_tables as _rope_tables)
+
+
+class LlamaRotaryEmbedding(nn.Layer):
+    def __init__(self, head_dim, max_position_embeddings=4096, theta=10000.0):
+        super().__init__()
+        self.head_dim = head_dim
+        self.max_position_embeddings = max_position_embeddings
+        self.theta = theta
+
+    def forward(self, seq_len):
+        cos, sin = _rope_tables(seq_len, self.head_dim, self.theta)
+        return Tensor(cos), Tensor(sin)  # float32 tables
+
+
+class LlamaAttention(nn.Layer):
+    """Multi-head (optionally grouped-query) causal self-attention with RoPE."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.hidden_size = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+
+        if _mp_enabled():
+            from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+                ColumnParallelLinear, RowParallelLinear)
+
+            mk = lambda i, o: ColumnParallelLinear(i, o, has_bias=False,
+                                                   gather_output=False)
+            self.q_proj = mk(self.hidden_size, self.num_heads * self.head_dim)
+            self.k_proj = mk(self.hidden_size, self.num_kv_heads * self.head_dim)
+            self.v_proj = mk(self.hidden_size, self.num_kv_heads * self.head_dim)
+            self.o_proj = RowParallelLinear(
+                self.num_heads * self.head_dim, self.hidden_size,
+                has_bias=False, input_is_parallel=True)
+        else:
+            self.q_proj = nn.Linear(self.hidden_size, self.num_heads * self.head_dim,
+                                    bias_attr=False)
+            self.k_proj = nn.Linear(self.hidden_size, self.num_kv_heads * self.head_dim,
+                                    bias_attr=False)
+            self.v_proj = nn.Linear(self.hidden_size, self.num_kv_heads * self.head_dim,
+                                    bias_attr=False)
+            self.o_proj = nn.Linear(self.num_heads * self.head_dim, self.hidden_size,
+                                    bias_attr=False)
+        self.rotary_emb = LlamaRotaryEmbedding(
+            self.head_dim, config.max_position_embeddings, config.rope_theta)
+
+    def forward(self, hidden_states, attention_mask=None, position_ids=None,
+                past_key_value=None, use_cache=False):
+        b, s = hidden_states.shape[0], hidden_states.shape[1]
+        q = self.q_proj(hidden_states)
+        k = self.k_proj(hidden_states)
+        v = self.v_proj(hidden_states)
+
+        q = paddle.reshape(q, [b, s, self.num_heads, self.head_dim])
+        k = paddle.reshape(k, [b, s, self.num_kv_heads, self.head_dim])
+        v = paddle.reshape(v, [b, s, self.num_kv_heads, self.head_dim])
+
+        offset = 0
+        if past_key_value is not None:
+            offset = past_key_value[0].shape[1]
+        theta, hd = self.config.rope_theta, self.head_dim
+
+        def rope_fn(qd, kd):
+            cos, sin = _rope_tables(offset + s, hd, theta)
+            return _apply_rope(qd, kd, cos[offset:], sin[offset:])
+
+        q, k = apply(rope_fn, q, k, _name="fused_rope")
+
+        if past_key_value is not None:
+            k = paddle.concat([past_key_value[0], k], axis=1)
+            v = paddle.concat([past_key_value[1], v], axis=1)
+        new_cache = (k, v) if use_cache else None
+
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = paddle.repeat_interleave(k, rep, axis=2)
+            v = paddle.repeat_interleave(v, rep, axis=2)
+
+        causal = past_key_value is None
+        if self.config.use_flash_attention and attention_mask is None:
+            out = flash_attn_mod.flash_attention(q, k, v, causal=causal)[0]
+        else:
+            # causality is kept even with a user mask (the reference folds the
+            # padding mask into the causal mask before attention)
+            out = flash_attn_mod.scaled_dot_product_attention(
+                q, k, v, attn_mask=attention_mask, is_causal=causal)
+        out = paddle.reshape(out, [b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if use_cache:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU MLP: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        if _mp_enabled():
+            from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+                ColumnParallelLinear, RowParallelLinear)
+
+            self.gate_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                                  gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, i, has_bias=False,
+                                                gather_output=False)
+            self.down_proj = RowParallelLinear(i, h, has_bias=False,
+                                               input_is_parallel=True)
+        else:
+            self.gate_proj = nn.Linear(h, i, bias_attr=False)
+            self.up_proj = nn.Linear(h, i, bias_attr=False)
+            self.down_proj = nn.Linear(i, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = LlamaRMSNorm(config.hidden_size,
+                                                     config.rms_norm_eps)
+        self.config = config
+
+    def forward(self, hidden_states, attention_mask=None, position_ids=None,
+                past_key_value=None, use_cache=False):
+        def block(h):
+            residual = h
+            h = self.input_layernorm(h)
+            h = self.self_attn(h, attention_mask, position_ids)
+            h = residual + h
+            residual = h
+            h = self.post_attention_layernorm(h)
+            h = self.mlp(h)
+            return residual + h
+
+        if use_cache:
+            residual = hidden_states
+            h = self.input_layernorm(hidden_states)
+            h, cache = self.self_attn(h, attention_mask, position_ids,
+                                      past_key_value, use_cache=True)
+            h = residual + h
+            residual = h
+            h = self.post_attention_layernorm(h)
+            h = self.mlp(h)
+            return residual + h, cache
+
+        if self.config.recompute:
+            from paddle_tpu.distributed.fleet.recompute import recompute
+
+            return recompute(block, hidden_states)
+        return block(hidden_states)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        if _mp_enabled():
+            from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+                VocabParallelEmbedding)
+
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = LlamaRMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids, attention_mask=None, position_ids=None,
+                past_key_values=None, use_cache=False):
+        h = self.embed_tokens(input_ids)
+        caches = [] if use_cache else None
+        for i, layer in enumerate(self.layers):
+            pkv = past_key_values[i] if past_key_values is not None else None
+            if use_cache:
+                h, cache = layer(h, attention_mask, position_ids, pkv, use_cache=True)
+                caches.append(cache)
+            else:
+                h = layer(h, attention_mask, position_ids)
+        h = self.norm(h)
+        if use_cache:
+            return h, caches
+        return h
+
+
+class LlamaPretrainingCriterion(nn.Layer):
+    """Shifted next-token cross entropy (the reference uses
+    ParallelCrossEntropy under mp; GSPMD handles the vocab-sharded logits)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+
+    def forward(self, logits, labels):
+        # logits: [b, s, vocab]; labels: [b, s]
+        loss = F.cross_entropy(
+            paddle.reshape(logits, [-1, logits.shape[-1]]),
+            paddle.reshape(labels, [-1]),
+            reduction="mean")
+        return loss
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = self.model = LlamaModel(config)
+        if _mp_enabled():
+            from paddle_tpu.distributed.fleet.layers.mpu.mp_layers import (
+                ColumnParallelLinear)
+
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                gather_output=True)
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attention_mask=None,
+                position_ids=None, past_key_values=None, use_cache=False):
+        if use_cache:
+            h, caches = self.model(input_ids, attention_mask, position_ids,
+                                   past_key_values, use_cache=True)
+            return self.lm_head(h), caches
+        h = self.model(input_ids, attention_mask, position_ids)
+        logits = self.lm_head(h)
+        if labels is not None:
+            return LlamaPretrainingCriterion(self.config)(logits, labels)
+        return logits
+
+    @paddle.no_grad()
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0):
+        """Greedy/temperature decode with KV cache."""
+        tokens = input_ids
+        past = None
+        cur = tokens
+        for _ in range(max_new_tokens):
+            logits, past = self.forward(cur, past_key_values=past, use_cache=True)
+            next_logits = logits[:, -1, :]
+            if temperature and temperature > 0:
+                next_logits = next_logits / temperature
+                probs = F.softmax(next_logits, axis=-1)
+                nxt = paddle.multinomial(probs, 1)
+            else:
+                nxt = paddle.argmax(next_logits, axis=-1, keepdim=True)
+            nxt = paddle.cast(nxt, tokens.dtype)
+            tokens = paddle.concat([tokens, nxt], axis=1)
+            cur = nxt
+        return tokens

@@ -1,0 +1,287 @@
+"""Pure-functional Llama core for the compiled (jit/pjit/shard_map) path.
+
+This is the TPU-native replacement for the reference's static-graph hybrid
+pipeline (`python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:684`
+forward_backward_pipeline + `fleet/layers/mpu/mp_layers.py` TP layers +
+`mp_ops.py:77-385` collectives): one set of pure functions over a params
+pytree, usable three ways —
+
+  1. plain single-device:            forward_and_loss(params, ids, labels, cfg)
+  2. GSPMD (jit + NamedSharding):    same functions; XLA inserts collectives
+  3. manual SPMD (shard_map):        pass mp_axis='mp' (+ sp=True) and the
+     functions issue the exact Megatron collectives by hand — psum for
+     row-parallel matmuls (reference `_mp_allreduce`, mp_ops.py:259),
+     all_gather/psum_scatter on the sequence dim for sequence parallelism
+     (reference `sequence_parallel_utils.py:85-147`), and vocab-parallel
+     embedding + cross entropy (reference mp_layers.py:49,744).
+
+Every weight is stored [in, out] so contractions land on the MXU untransposed.
+Layer params are *stacked* along a leading n_layers dim and iterated with
+`lax.scan` — static control flow, one compiled layer body, and the leading
+dim is exactly what pipeline parallelism shards over the 'pp' mesh axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class LlamaArgs(NamedTuple):
+    """Static (hashable) model config used inside jit."""
+
+    vocab_size: int
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    rope_theta: float
+    rms_eps: float
+    use_flash: bool = True
+
+    @staticmethod
+    def from_config(cfg):
+        return LlamaArgs(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.hidden_size,
+            intermediate_size=cfg.intermediate_size,
+            num_layers=cfg.num_hidden_layers,
+            num_heads=cfg.num_attention_heads,
+            num_kv_heads=cfg.num_key_value_heads,
+            rope_theta=cfg.rope_theta,
+            rms_eps=cfg.rms_norm_eps,
+            use_flash=cfg.use_flash_attention,
+        )
+
+
+# --------------------------------------------------------------------------
+# init
+# --------------------------------------------------------------------------
+
+
+def init_layer_params(args: LlamaArgs, key, dtype=jnp.float32):
+    """One decoder layer's params (unstacked)."""
+    h, i = args.hidden_size, args.intermediate_size
+    hd = h // args.num_heads
+    ks = jax.random.split(key, 7)
+    init = jax.nn.initializers.normal(0.02)
+    return {
+        "wq": init(ks[0], (h, args.num_heads * hd), dtype),
+        "wk": init(ks[1], (h, args.num_kv_heads * hd), dtype),
+        "wv": init(ks[2], (h, args.num_kv_heads * hd), dtype),
+        "wo": init(ks[3], (args.num_heads * hd, h), dtype),
+        "w_gate": init(ks[4], (h, i), dtype),
+        "w_up": init(ks[5], (h, i), dtype),
+        "w_down": init(ks[6], (i, h), dtype),
+        "ln1": jnp.ones((h,), dtype),
+        "ln2": jnp.ones((h,), dtype),
+    }
+
+
+def init_params(args: LlamaArgs, key, dtype=jnp.float32):
+    """Full model params. layers.* leaves have leading dim [num_layers]."""
+    k_emb, k_head, k_layers = jax.random.split(key, 3)
+    init = jax.nn.initializers.normal(0.02)
+    layer_keys = jax.random.split(k_layers, args.num_layers)
+    layers = jax.vmap(lambda k: init_layer_params(args, k, dtype))(layer_keys)
+    return {
+        "embedding": init(k_emb, (args.vocab_size, args.hidden_size), dtype),
+        "layers": layers,
+        "final_norm": jnp.ones((args.hidden_size,), dtype),
+        "lm_head": init(k_head, (args.hidden_size, args.vocab_size), dtype),
+    }
+
+
+# --------------------------------------------------------------------------
+# building blocks (mp_axis=None -> single device / GSPMD; else shard_map SPMD)
+# --------------------------------------------------------------------------
+
+
+def rms_norm(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+def rope_tables(seq_len, head_dim, theta):
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def apply_rope(q, k, cos, sin):
+    def rot(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate([-x2, x1], axis=-1)
+
+    dt = q.dtype
+    q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
+    c, s = cos[None, :, None, :], sin[None, :, None, :]
+    return ((q32 * c + rot(q32) * s).astype(dt),
+            (k32 * c + rot(k32) * s).astype(dt))
+
+
+def _attention(q, k, v, use_flash):
+    """q,k,v: [b, s, h, d], causal."""
+    if use_flash and jax.default_backend() == "tpu":
+        try:
+            from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+
+            return flash_attention_fwd(q, k, v, causal=True)
+        except Exception:
+            pass
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / np.sqrt(d))
+    s = logits.shape[-1]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def decoder_layer(p, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
+                  sp=False):
+    """One decoder block. Under shard_map (mp_axis set) the weights held by
+    this device are the mp-shards: wq/wk/wv/w_gate/w_up sharded on the out
+    dim, wo/w_down on the in dim; heads are local heads."""
+    nh = args.num_heads // (mp_degree if mp_axis else 1)
+    nkv = max(1, args.num_kv_heads // (mp_degree if mp_axis else 1))
+    hd = args.hidden_size // args.num_heads
+
+    def maybe_gather_seq(x):
+        # SP: activations arrive seq-sharded over the mp axis; gather full seq
+        # for attention/matmul (reference AllGatherOp,
+        # sequence_parallel_utils.py:120).
+        if sp and mp_axis:
+            return jax.lax.all_gather(x, mp_axis, axis=1, tiled=True)
+        return x
+
+    def reduce_out(x):
+        # Row-parallel output reduction: psum (reference _mp_allreduce,
+        # mp_ops.py:259), or reduce-scatter back to seq shards under SP
+        # (reference ReduceScatterOp, sequence_parallel_utils.py:134).
+        if mp_axis is None:
+            return x
+        if sp:
+            return jax.lax.psum_scatter(x, mp_axis, scatter_dimension=1, tiled=True)
+        return jax.lax.psum(x, mp_axis)
+
+    # --- attention ---
+    hin = rms_norm(h, p["ln1"], args.rms_eps)
+    hin = maybe_gather_seq(hin)
+    b, s = hin.shape[0], hin.shape[1]
+    q = (hin @ p["wq"]).reshape(b, s, nh, hd)
+    k = (hin @ p["wk"]).reshape(b, s, nkv, hd)
+    v = (hin @ p["wv"]).reshape(b, s, nkv, hd)
+    cos_t, sin_t = cos[:s], sin[:s]
+    q, k = apply_rope(q, k, cos_t, sin_t)
+    if nkv != nh:
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    attn = _attention(q, k, v, args.use_flash)
+    attn = attn.reshape(b, s, nh * hd)
+    h = h + reduce_out(attn @ p["wo"])
+
+    # --- MLP (SwiGLU) ---
+    hin = rms_norm(h, p["ln2"], args.rms_eps)
+    hin = maybe_gather_seq(hin)
+    act = jax.nn.silu(hin @ p["w_gate"]) * (hin @ p["w_up"])
+    h = h + reduce_out(act @ p["w_down"])
+    return h
+
+
+def run_layers(stack, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
+               sp=False, remat=True):
+    """lax.scan over stacked layer params (leading dim = layers)."""
+    body = functools.partial(decoder_layer, args=args, mp_axis=mp_axis,
+                             mp_degree=mp_degree, sp=sp)
+    if remat:
+        body = jax.checkpoint(body)
+
+    def step(carry, lp):
+        return body(lp, carry, cos, sin), None
+
+    h, _ = jax.lax.scan(step, h, stack)
+    return h
+
+
+def embed_lookup(table, ids, args: LlamaArgs, mp_axis=None, mp_degree=1):
+    """Vocab-parallel embedding (reference VocabParallelEmbedding,
+    mp_layers.py:49): table local shard [V/mp, h]; out-of-shard ids
+    contribute zeros, psum combines."""
+    if mp_axis is None:
+        return jnp.take(table, ids, axis=0)
+    per = args.vocab_size // mp_degree
+    rank = jax.lax.axis_index(mp_axis)
+    start = rank * per
+    local = ids - start
+    valid = (local >= 0) & (local < per)
+    local = jnp.clip(local, 0, per - 1)
+    out = jnp.take(table, local, axis=0)
+    out = jnp.where(valid[..., None], out, 0.0)
+    return jax.lax.psum(out, mp_axis)
+
+
+def parallel_cross_entropy(logits, labels, args: LlamaArgs, mp_axis=None,
+                           mp_degree=1):
+    """Softmax cross entropy over (possibly vocab-sharded) logits.
+
+    Reference ParallelCrossEntropy (mp_layers.py:744) /
+    `_c_softmax_with_cross_entropy` (mp_ops.py:385): max and sum-exp are
+    psum-reduced over the mp axis; the true-label logit is recovered with a
+    mask + psum.
+    """
+    logits = logits.astype(jnp.float32)
+    if mp_axis is None:
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)) + m[..., 0]
+        true_logit = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - true_logit)
+    per = args.vocab_size // mp_degree
+    rank = jax.lax.axis_index(mp_axis)
+    start = rank * per
+    m_local = jnp.max(logits, axis=-1, keepdims=True)
+    # max is only a numerical shift; stop_gradient keeps pmax out of the vjp
+    m = jax.lax.pmax(jax.lax.stop_gradient(m_local), mp_axis)
+    sum_local = jnp.sum(jnp.exp(logits - m), axis=-1)
+    lse = jnp.log(jax.lax.psum(sum_local, mp_axis)) + m[..., 0]
+    local_lab = labels - start
+    valid = (local_lab >= 0) & (local_lab < per)
+    local_lab = jnp.clip(local_lab, 0, per - 1)
+    tl = jnp.take_along_axis(logits, local_lab[..., None], axis=-1)[..., 0]
+    true_logit = jax.lax.psum(jnp.where(valid, tl, 0.0), mp_axis)
+    return jnp.mean(lse - true_logit)
+
+
+def forward(params, ids, args: LlamaArgs, mp_axis=None, mp_degree=1, sp=False,
+            remat=True):
+    """Full forward to logits. ids: [b, s] int32."""
+    h = embed_lookup(params["embedding"], ids, args, mp_axis, mp_degree)
+    if sp and mp_axis:
+        # enter the seq-sharded region (reference ScatterOp,
+        # sequence_parallel_utils.py:85): keep this rank's seq slice
+        s_local = ids.shape[1] // mp_degree
+        rank = jax.lax.axis_index(mp_axis)
+        h = jax.lax.dynamic_slice_in_dim(h, rank * s_local, s_local, axis=1)
+    cos, sin = rope_tables(ids.shape[1], args.hidden_size // args.num_heads,
+                           args.rope_theta)
+    h = run_layers(params["layers"], h, cos, sin, args, mp_axis, mp_degree, sp,
+                   remat)
+    h = rms_norm(h, params["final_norm"], args.rms_eps)
+    if sp and mp_axis:
+        h = jax.lax.all_gather(h, mp_axis, axis=1, tiled=True)
+    logits = h @ params["lm_head"]
+    return logits
+
+
+def forward_and_loss(params, ids, labels, args: LlamaArgs, mp_axis=None,
+                     mp_degree=1, sp=False, remat=True):
+    logits = forward(params, ids, args, mp_axis, mp_degree, sp, remat)
+    return parallel_cross_entropy(logits, labels, args, mp_axis, mp_degree)

@@ -23,7 +23,11 @@ def _sdpa_reference(q, k, v, causal=False, dropout=0.0, scale=None, mask=None):
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
     if mask is not None:
-        logits = logits + mask.astype(logits.dtype)
+        if mask.dtype == jnp.bool_:
+            # paddle bool-mask semantics: False = masked out
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(logits.dtype)
     if causal:
         ql, kl = logits.shape[-2], logits.shape[-1]
         causal_mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
@@ -34,7 +38,7 @@ def _sdpa_reference(q, k, v, causal=False, dropout=0.0, scale=None, mask=None):
 
 
 def _use_pallas(q):
-    return jax.default_backend() == "tpu" and q.shape[-1] % 128 == 0 and q.shape[1] % 128 == 0
+    return jax.default_backend() == "tpu" and q.shape[1] % 128 == 0
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,

@@ -25,6 +25,18 @@ def reshape_(x, shape, name=None):
     return x
 
 
+def cast(x, dtype, name=None):
+    """paddle.cast (reference: python/paddle/tensor/manipulation.py cast)."""
+    return x.astype(dtype)
+
+
+def cast_(x, dtype, name=None):
+    from paddle_tpu.framework import dtypes
+
+    x._data = x._data.astype(dtypes.convert_dtype(dtype))
+    return x
+
+
 def view(x, shape_or_dtype, name=None):
     if isinstance(shape_or_dtype, (list, tuple)):
         return reshape(x, shape_or_dtype)

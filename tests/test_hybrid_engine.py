@@ -1,0 +1,78 @@
+"""Hybrid-parallel engine tests on the virtual 8-device CPU mesh.
+
+Mirrors the reference's convergence-parity test style
+(`test/collective/fleet/hybrid_parallel_mp_model.py`,
+`test/auto_parallel/hybrid_strategy/semi_auto_llama_acc_align.py`):
+the parallel loss must match the single-device loss on the same params/batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.models import llama_functional as lf
+from paddle_tpu.distributed.hybrid_engine import HybridParallelEngine
+
+
+def _tiny_cfg():
+    return LlamaConfig.tiny(
+        num_hidden_layers=4, hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, vocab_size=128, max_position_embeddings=64)
+
+
+def _batch(B=8, s=32, vocab=128, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, vocab, (B, s)).astype(np.int32),
+            rng.integers(0, vocab, (B, s)).astype(np.int32))
+
+
+def _gather(tree):
+    return jax.tree.map(lambda a: np.asarray(a), tree)
+
+
+@pytest.mark.parametrize("dp,pp,mp,sp", [
+    (2, 2, 2, True),
+    (2, 2, 2, False),
+    (4, 1, 2, False),
+    (1, 4, 2, True),
+])
+def test_hybrid_loss_matches_single_device(dp, pp, mp, sp):
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=dp, pp=pp, mp=mp, micro_batches=2, sp=sp,
+                               remat=True)
+    params, opt = eng.init_state(0)
+    ids, labels = _batch()
+    loss, new_params, new_opt = eng.train_batch(params, opt, ids, labels)
+
+    # single-device reference on the same params/batch
+    args = lf.LlamaArgs.from_config(cfg)
+    # params were donated; re-init identically
+    ref_params = lf.init_params(args, jax.random.key(0))
+    ref_loss = lf.forward_and_loss(ref_params, jnp.asarray(ids),
+                                   jnp.asarray(labels), args, remat=False)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-4,
+                               err_msg=f"dp={dp} pp={pp} mp={mp} sp={sp}")
+
+
+def test_hybrid_trains():
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=2, pp=2, mp=2, micro_batches=2, sp=True)
+    params, opt = eng.init_state(0)
+    ids, labels = _batch()
+    losses = []
+    for _ in range(3):
+        loss, params, opt = eng.train_batch(params, opt, ids, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_zero_sharding_of_opt_state():
+    """ZeRO-1: AdamW moments carry an extra 'dp' shard dim."""
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=2, pp=2, mp=2, micro_batches=2)
+    params, opt = eng.init_state(0)
+    wq_m = opt["m"]["layers"]["wq"]
+    spec = wq_m.sharding.spec
+    assert "dp" in tuple(spec), spec
