@@ -1,0 +1,226 @@
+"""ctypes bridge to the native runtime core (csrc/ -> libpaddle_tpu_core.so).
+
+Counterpart of the reference's `libpaddle` pybind module
+(`paddle/fluid/pybind/pybind.cc`) for the runtime pieces that live in C++:
+TCPStore rendezvous (`paddle/phi/core/distributed/store/tcp_store.h`),
+the flag registry (`paddle/common/flags.cc`) and the comm watchdog
+(`paddle/phi/core/distributed/comm_task_manager.cc`). A plain C ABI +
+ctypes keeps the build free of Python headers; if the library has not been
+built, `available()` is False and pure-Python fallbacks are used.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_LIB_CANDIDATES = [
+    os.path.join(_REPO_ROOT, "csrc", "build", "libpaddle_tpu_core.so"),
+    os.path.join(os.path.dirname(__file__), "..", "lib",
+                 "libpaddle_tpu_core.so"),
+]
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_attempted = False
+
+
+def _try_build():
+    """One-shot cmake+ninja build of csrc (dev checkouts)."""
+    global _build_attempted
+    if _build_attempted:
+        return
+    _build_attempted = True
+    csrc = os.path.join(_REPO_ROOT, "csrc")
+    if not os.path.isdir(csrc):
+        return
+    try:
+        subprocess.run(["cmake", "-B", "build", "-G", "Ninja"], cwd=csrc,
+                       capture_output=True, timeout=120, check=True)
+        subprocess.run(["ninja", "-C", "build"], cwd=csrc,
+                       capture_output=True, timeout=300, check=True)
+    except Exception:
+        pass
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        for path in _LIB_CANDIDATES:
+            if not os.path.exists(path):
+                continue
+            lib = ctypes.CDLL(path)
+            _configure(lib)
+            _lib = lib
+            return _lib
+        _try_build()
+        for path in _LIB_CANDIDATES:
+            if os.path.exists(path):
+                lib = ctypes.CDLL(path)
+                _configure(lib)
+                _lib = lib
+                return _lib
+        return None
+
+
+def _configure(lib):
+    lib.pt_last_error.restype = ctypes.c_char_p
+    lib.pt_store_create.restype = ctypes.c_void_p
+    lib.pt_store_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.pt_store_destroy.argtypes = [ctypes.c_void_p]
+    lib.pt_store_set.restype = ctypes.c_int
+    lib.pt_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int64]
+    lib.pt_store_get.restype = ctypes.c_int64
+    lib.pt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_int64, ctypes.c_int]
+    lib.pt_store_add.restype = ctypes.c_int64
+    lib.pt_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int64]
+    lib.pt_store_wait.restype = ctypes.c_int
+    lib.pt_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int]
+    lib.pt_store_barrier.restype = ctypes.c_int
+    lib.pt_store_barrier.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.pt_flags_set.restype = ctypes.c_int
+    lib.pt_flags_set.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.pt_flags_get.restype = ctypes.c_char_p
+    lib.pt_flags_get.argtypes = [ctypes.c_char_p]
+    lib.pt_flags_list.restype = ctypes.c_char_p
+    lib.pt_watchdog_start.restype = ctypes.c_void_p
+    lib.pt_watchdog_start.argtypes = [ctypes.c_int, ctypes.c_void_p]
+    lib.pt_watchdog_stop.argtypes = [ctypes.c_void_p]
+    lib.pt_watchdog_begin.restype = ctypes.c_int
+    lib.pt_watchdog_begin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_int]
+    lib.pt_watchdog_end.restype = ctypes.c_int
+    lib.pt_watchdog_end.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+
+
+def available():
+    return _load() is not None
+
+
+def last_error():
+    lib = _load()
+    return lib.pt_last_error().decode() if lib else "native lib not built"
+
+
+class TCPStore:
+    """reference `paddle/phi/core/distributed/store/tcp_store.h` surface."""
+
+    def __init__(self, host, port, is_master=False, world_size=1,
+                 timeout=30.0):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native core not built (csrc/); run "
+                               "cmake -B build -G Ninja && ninja -C build")
+        self._lib = lib
+        self._h = lib.pt_store_create(host.encode(), int(port),
+                                      1 if is_master else 0, world_size,
+                                      int(timeout * 1000))
+        if not self._h:
+            raise RuntimeError(f"TCPStore create failed: {last_error()}")
+        self.host, self.port, self.world_size = host, port, world_size
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.pt_store_set(self._h, key.encode(), value, len(value))
+        if rc != 0:
+            raise RuntimeError(f"store set failed: {last_error()}")
+
+    def get(self, key, timeout=30.0):
+        cap = 1 << 20
+        buf = ctypes.create_string_buffer(cap)
+        n = self._lib.pt_store_get(self._h, key.encode(), buf, cap,
+                                   int(timeout * 1000))
+        if n < 0:
+            raise RuntimeError(f"store get({key!r}) timed out")
+        return buf.raw[:n]
+
+    def add(self, key, delta):
+        v = self._lib.pt_store_add(self._h, key.encode(), int(delta))
+        if v == -(2 ** 63):
+            raise RuntimeError(f"store add failed: {last_error()}")
+        return v
+
+    def wait(self, key, timeout=30.0):
+        if self._lib.pt_store_wait(self._h, key.encode(),
+                                   int(timeout * 1000)) != 0:
+            raise RuntimeError(f"store wait({key!r}) timed out")
+
+    def barrier(self, prefix, rank, world_size=None, timeout=30.0):
+        rc = self._lib.pt_store_barrier(
+            self._h, prefix.encode(), rank, world_size or self.world_size,
+            int(timeout * 1000))
+        if rc != 0:
+            raise RuntimeError(f"store barrier timed out: {last_error()}")
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pt_store_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class Watchdog:
+    """reference CommTaskManager (`comm_task_manager.cc:152`): deadline
+    monitor for barriers/collectives — reports and fires a callback instead
+    of hanging silently."""
+
+    def __init__(self, poll_interval=1.0, on_timeout=None):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native core not built")
+        self._lib = lib
+        self._cb_type = ctypes.CFUNCTYPE(None, ctypes.c_char_p,
+                                         ctypes.c_int64)
+        self._cb = (self._cb_type(
+            lambda name, ms: on_timeout(name.decode(), ms))
+            if on_timeout else None)
+        self._h = lib.pt_watchdog_start(
+            int(poll_interval * 1000),
+            ctypes.cast(self._cb, ctypes.c_void_p) if self._cb else None)
+
+    def begin(self, task, timeout=60.0):
+        self._lib.pt_watchdog_begin(self._h, task.encode(),
+                                    int(timeout * 1000))
+
+    def end(self, task):
+        self._lib.pt_watchdog_end(self._h, task.encode())
+
+    def stop(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_watchdog_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+def flags_set(name, value):
+    lib = _load()
+    if lib:
+        lib.pt_flags_set(name.encode(), str(value).encode())
+
+
+def flags_get(name):
+    lib = _load()
+    if not lib:
+        return None
+    v = lib.pt_flags_get(name.encode())
+    return v.decode() if v is not None else None

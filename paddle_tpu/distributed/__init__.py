@@ -48,8 +48,11 @@ def __getattr__(name):
         try:
             mod = _importlib.import_module(f"paddle_tpu.distributed.{name}")
         except ModuleNotFoundError as e:
-            raise AttributeError(
-                f"module 'paddle_tpu.distributed' has no attribute {name!r}") from e
+            if e.name == f"paddle_tpu.distributed.{name}":
+                raise AttributeError(
+                    f"module 'paddle_tpu.distributed' has no attribute "
+                    f"{name!r}") from e
+            raise  # a real missing dependency inside the submodule
         globals()[name] = mod
         return mod
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")

@@ -1,0 +1,9 @@
+from paddle_tpu.distributed.checkpoint.save_state_dict import (  # noqa: F401
+    save_state_dict,
+)
+from paddle_tpu.distributed.checkpoint.load_state_dict import (  # noqa: F401
+    load_state_dict,
+)
+from paddle_tpu.distributed.checkpoint.metadata import (  # noqa: F401
+    Metadata, TensorMetadata,
+)

@@ -1,0 +1,58 @@
+"""Distributed checkpoint load with reshard-on-load.
+
+Reference: `python/paddle/distributed/checkpoint/load_state_dict.py` — reads
+the global Metadata, figures out which saved shards intersect each local
+shard, and reassembles. Here the saved value is logical, so "reshard" is one
+`jax.device_put` onto each destination tensor's *current* sharding — loading
+a checkpoint saved under dp2/mp4 into a dp4/mp2 run just works.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from paddle_tpu.distributed.checkpoint.metadata import Metadata
+from paddle_tpu.distributed.checkpoint.save_state_dict import (
+    _META_FILE, _flatten_state)
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None, offload=False):
+    """Fill `state_dict`'s tensors in place from `path`."""
+    import jax
+
+    from paddle_tpu.core.tensor import Tensor
+
+    md = Metadata.load(os.path.join(path, _META_FILE))
+    flat = _flatten_state(state_dict)
+    missing = [k for k in flat if k not in md.tensors]
+    if missing:
+        raise ValueError(f"checkpoint at {path} is missing tensors {missing[:5]}"
+                         f"{'...' if len(missing) > 5 else ''}")
+    for name, t in flat.items():
+        tm = md.tensors[name]
+        host = np.load(os.path.join(path, tm.file))
+        if isinstance(t, Tensor):
+            if list(host.shape) != list(t.shape):
+                raise ValueError(
+                    f"{name}: saved shape {list(host.shape)} != target "
+                    f"{list(t.shape)}")
+            sharding = getattr(t._data, "sharding", None)
+            arr = (jax.device_put(host.astype(t._data.dtype), sharding)
+                   if sharding is not None else
+                   jax.numpy.asarray(host.astype(t._data.dtype)))
+            t._data = arr
+        elif hasattr(t, "sharding"):  # bare jax.Array in the dict
+            state_dict_set(state_dict, name,
+                           jax.device_put(host, t.sharding))
+    return state_dict
+
+
+def state_dict_set(state_dict, dotted, value):
+    parts = dotted.split(".")
+    d = state_dict
+    for p in parts[:-1]:
+        d = d[p]
+    d[parts[-1]] = value

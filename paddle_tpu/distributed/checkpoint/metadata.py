@@ -1,0 +1,45 @@
+"""Checkpoint metadata (reference `distributed/checkpoint/metadata.py`):
+a global map tensor-name -> {shape, dtype, shard files} that makes
+reshard-on-load across different meshes/degrees possible."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class TensorMetadata:
+    name: str
+    shape: List[int]
+    dtype: str
+    file: str
+    # sharding at save time, informational (load reshards to the target's
+    # current sharding regardless)
+    mesh_shape: Optional[List[int]] = None
+    mesh_axes: Optional[List[str]] = None
+    partition_spec: Optional[List] = None
+
+
+@dataclasses.dataclass
+class Metadata:
+    tensors: Dict[str, TensorMetadata] = dataclasses.field(default_factory=dict)
+    version: int = 1
+
+    def dump(self, path):
+        with open(path, "w") as f:
+            json.dump({
+                "version": self.version,
+                "tensors": {k: dataclasses.asdict(v)
+                            for k, v in self.tensors.items()},
+            }, f, indent=1)
+
+    @staticmethod
+    def load(path):
+        with open(path) as f:
+            raw = json.load(f)
+        md = Metadata(version=raw.get("version", 1))
+        for k, v in raw["tensors"].items():
+            md.tensors[k] = TensorMetadata(**v)
+        return md

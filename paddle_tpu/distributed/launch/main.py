@@ -1,0 +1,70 @@
+"""Launcher CLI: `python -m paddle_tpu.distributed.launch [opts] script.py args`.
+
+Reference: `python/paddle/distributed/launch/main.py:23` +
+`launch/controllers/collective.py:22-139` — spawns one process per rank on
+each node, wiring PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS / PADDLE_MASTER.
+
+TPU-native design: JAX is single-controller **per host** — one process drives
+all local chips, so "nproc_per_node" collapses to 1 and the launcher's job is
+the *multi-host* rendezvous: set the coordination-service address and call
+`jax.distributed.initialize` before handing off to the training script
+(the TPU analogue of the reference's TCPStore rendezvous,
+`parallel.py:1134`). The reference env contract is still exported so fleet's
+RoleMaker parses the same variables.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="multi-host launcher (reference launch/main.py)")
+    p.add_argument("--master", default=None,
+                   help="coordinator address host:port (reference PADDLE_MASTER)")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--rank", "--node_rank", dest="rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="accepted for compat; JAX drives all local chips "
+                        "from one process")
+    p.add_argument("--devices", "--gpus", dest="devices", default=None)
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+
+    # reference env contract (launch/controllers/collective.py:70-139)
+    os.environ["PADDLE_TRAINER_ID"] = str(args.rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    os.environ.setdefault("PADDLE_RANK_IN_NODE", "0")
+    if args.master:
+        os.environ["PADDLE_MASTER"] = args.master
+
+    if args.nnodes > 1:
+        if not args.master:
+            raise SystemExit("--master host:port is required for nnodes > 1")
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=args.master,
+            num_processes=args.nnodes,
+            process_id=args.rank,
+        )
+
+    sys.argv = [args.script] + list(args.script_args)
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    launch()

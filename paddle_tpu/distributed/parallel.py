@@ -73,6 +73,23 @@ def init_parallel_env():
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     proc_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     if master and nprocs > 1 and not jax.distributed.is_initialized():
+        # native TCPStore rendezvous (reference parallel.py:1134): rank 0
+        # hosts the store; everyone barriers so jax.distributed.initialize
+        # only starts once all hosts are up (clearer failures than a
+        # coordination-service connect timeout)
+        global _store
+        try:
+            from paddle_tpu.core import native
+
+            if native.available():
+                host, port = master.rsplit(":", 1)
+                _store = native.TCPStore(host, int(port) + 1,
+                                         is_master=proc_id == 0,
+                                         world_size=nprocs)
+                _store.barrier("init_parallel_env", proc_id, nprocs,
+                               timeout=300.0)
+        except Exception:
+            _store = None  # fall through to the coordination service alone
         jax.distributed.initialize(
             coordinator_address=master, num_processes=nprocs,
             process_id=proc_id)

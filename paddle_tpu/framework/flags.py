@@ -42,6 +42,16 @@ def _bootstrap_from_env():
 def set_flags(flags_dict):
     for k, v in flags_dict.items():
         _flags[k] = v
+    # mirror into the native registry so C++ components see the same values
+    # (reference: one flags.cc registry shared by both languages)
+    try:
+        from paddle_tpu.core import native
+
+        if native.available():
+            for k, v in flags_dict.items():
+                native.flags_set(k, v)
+    except Exception:
+        pass
 
 
 def get_flags(flags):

@@ -1,0 +1,4 @@
+from paddle_tpu.incubate.distributed.models.moe.moe_layer import (  # noqa: F401
+    MoELayer,
+)
+from paddle_tpu.incubate.distributed.models.moe import gate  # noqa: F401

@@ -1,0 +1,111 @@
+"""MoE gates: naive / gshard / switch.
+
+Reference: `python/paddle/incubate/distributed/models/moe/gate/`
+(naive_gate.py, gshard_gate.py, switch_gate.py).
+
+Each gate maps token representations [tokens, d_model] to (dispatch weights,
+expert assignment, aux loss). TPU-native: assignment is returned as dense
+one-hot combine/dispatch tensors (GShard style) so the whole MoE layer is
+einsum + all_to_all — no scatter/gather with data-dependent shapes, which
+XLA cannot tile.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+from paddle_tpu import nn
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, d_model, num_expert):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.weight = self.create_parameter(
+            [d_model, num_expert],
+            default_initializer=nn.initializer.XavierUniform())
+        self.loss = None
+
+
+class NaiveGate(BaseGate):
+    """top-k softmax gate without auxiliary loss (naive_gate.py)."""
+
+    def __init__(self, d_model, num_expert, topk=2):
+        super().__init__(d_model, num_expert)
+        self.topk = topk
+
+    def forward(self, x):
+        topk, n_exp = self.topk, self.num_expert
+
+        def fn(xd, w):
+            logits = xd @ w  # [T, E]
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            topv, topi = jax.lax.top_k(probs, topk)
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+            # dense combine weights [T, E]
+            combine = jax.vmap(
+                lambda c, i, v: c.at[i].set(v))(jnp.zeros_like(probs), topi, topv)
+            aux = jnp.zeros((), jnp.float32)
+            return combine, aux
+
+        combine, aux = apply(fn, x, self.weight, _name="moe_gate")
+        self.loss = aux
+        return combine
+
+
+class GShardGate(BaseGate):
+    """top-2 gate with GShard load-balancing aux loss (gshard_gate.py)."""
+
+    def __init__(self, d_model, num_expert, topk=2, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(d_model, num_expert)
+        self.topk = topk
+
+    def forward(self, x):
+        topk, n_exp = self.topk, self.num_expert
+
+        def fn(xd, w):
+            logits = xd @ w
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            topv, topi = jax.lax.top_k(probs, topk)
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+            combine = jax.vmap(
+                lambda c, i, v: c.at[i].set(v))(jnp.zeros_like(probs), topi, topv)
+            # GShard aux: mean gate prob per expert * fraction routed there
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean((combine > 0).astype(jnp.float32), axis=0)
+            aux = jnp.sum(me * ce) * n_exp
+            return combine, aux
+
+        combine, aux = apply(fn, x, self.weight, _name="gshard_gate")
+        self.loss = aux
+        return combine
+
+
+class SwitchGate(BaseGate):
+    """top-1 switch-transformer gate (switch_gate.py)."""
+
+    def __init__(self, d_model, num_expert, topk=1, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(d_model, num_expert)
+
+    def forward(self, x):
+        n_exp = self.num_expert
+
+        def fn(xd, w):
+            logits = xd @ w
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            top1 = jnp.argmax(probs, axis=-1)
+            onehot = jax.nn.one_hot(top1, n_exp, dtype=probs.dtype)
+            combine = onehot * jnp.max(probs, axis=-1, keepdims=True)
+            me = jnp.mean(probs, axis=0)
+            ce = jnp.mean(onehot, axis=0)
+            aux = jnp.sum(me * ce) * n_exp
+            return combine, aux
+
+        combine, aux = apply(fn, x, self.weight, _name="switch_gate")
+        self.loss = aux
+        return combine

@@ -1,0 +1,12 @@
+"""paddle.incubate.nn fused layers (reference
+`python/paddle/incubate/nn/layer/fused_transformer.py`)."""
+
+from paddle_tpu.incubate.nn import functional  # noqa: F401
+from paddle_tpu.incubate.nn.layer.fused_transformer import (  # noqa: F401
+    FusedMultiHeadAttention,
+    FusedFeedForward,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]

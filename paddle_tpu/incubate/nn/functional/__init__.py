@@ -1,0 +1,192 @@
+"""Fused functional ops (reference `python/paddle/incubate/nn/functional/`,
+backed by `paddle/phi/kernels/fusion/gpu/*`).
+
+TPU-native: "fused" here means "one traced region XLA fuses" — the
+elementwise chains fuse into neighbouring matmuls automatically, and the
+attention core dispatches to the Pallas flash kernel. The API mirrors the
+reference so incubate users port unchanged.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = [
+    "fused_multi_head_attention", "fused_feedforward", "fused_linear",
+    "fused_bias_dropout_residual_layer_norm", "fused_rms_norm",
+    "fused_rotary_position_embedding", "swiglu", "fused_dropout_add",
+]
+
+
+def swiglu(x, y=None, name=None):
+    """reference `incubate/nn/functional/swiglu.py`: silu(x) * y (or split)."""
+    if y is None:
+        def fn(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return apply(fn, x, _name="swiglu")
+    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, _name="swiglu")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def fn(a, w, *b):
+        w = w.T if transpose_weight else w
+        out = a @ w
+        return out + b[0] if b else out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(fn, *args, _name="fused_linear")
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, name=None):
+    """reference fused_rms_norm (phi fusion kernel); fp32 accumulation."""
+
+    def fn(a, w, *b):
+        a32 = a.astype(jnp.float32)
+        var = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        out = (a32 * jax.lax.rsqrt(var + epsilon)).astype(a.dtype) * w
+        return out + b[0] if b else out
+
+    args = (x, norm_weight) + ((norm_bias,) if norm_bias is not None else ())
+    return apply(fn, *args, _name="fused_rms_norm")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    name=None):
+    """reference `incubate/nn/functional/fused_rotary_position_embedding`;
+    q/k: [b, s, h, d]."""
+    from paddle_tpu.models.llama_functional import apply_rope, rope_tables
+
+    def fn(qd, kd):
+        s, d = qd.shape[1], qd.shape[-1]
+        if sin is None:
+            c, sn = rope_tables(s, d, 10000.0)
+        else:
+            c = (cos._data if isinstance(cos, Tensor) else cos).reshape(s, d)
+            sn = (sin._data if isinstance(sin, Tensor) else sin).reshape(s, d)
+        return apply_rope(qd, kd, c, sn)
+
+    if k is None:
+        out = apply(lambda qd: fn(qd, qd)[0], q, _name="fused_rope")
+        return out, None, None
+    qo, ko = apply(fn, q, k, _name="fused_rope")
+    return qo, ko, v
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from paddle_tpu.nn.functional.common import dropout
+
+    return dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True, mode="upscale_in_train",
+                                           name=None):
+    """reference fused_bias_dropout_residual_layer_norm
+    (`fusion/gpu/fused_bias_dropout_residual_layer_norm_kernel.cu`)."""
+    from paddle_tpu.nn.functional.common import dropout
+    from paddle_tpu.nn.functional.norm import layer_norm
+
+    h = x if bias is None else x + bias
+    h = dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = h + residual
+    return layer_norm(h, h.shape[-1:], weight=ln_scale, bias=ln_bias,
+                      epsilon=ln_epsilon)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None,
+                               ln_scale=None, ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    """reference FusedMultiHeadAttention functional
+    (`incubate/nn/layer/fused_transformer.py:213`): [pre-LN ->] qkv matmul ->
+    attention (Pallas flash when unmasked) -> out proj -> dropout ->
+    [residual] -> [post-LN]."""
+    import importlib
+
+    fa = importlib.import_module("paddle_tpu.nn.functional.flash_attention")
+    from paddle_tpu.nn.functional.common import dropout
+    from paddle_tpu.nn.functional.norm import layer_norm
+
+    residual = x
+    if pre_layer_norm:
+        x = layer_norm(x, x.shape[-1:], weight=pre_ln_scale, bias=pre_ln_bias,
+                       epsilon=pre_ln_epsilon)
+    b, s, h = x.shape
+    # qkv_weight: [3, n_heads, head_dim, h] (reference layout)
+    nh = qkv_weight.shape[1]
+    hd = qkv_weight.shape[2]
+
+    def qkv_fn(a, w, *bias):
+        qkv = jnp.einsum("bsh,tnadh->tbsna" if w.ndim == 5 else "bsh,tndh->tbsnd",
+                         a, w)
+        if bias:
+            qkv = qkv + bias[0].reshape(3, 1, 1, nh, hd)
+        return qkv[0], qkv[1], qkv[2]
+
+    args = (x, qkv_weight) + ((qkv_bias,) if qkv_bias is not None else ())
+    q, k, v = apply(qkv_fn, *args, _name="fused_qkv")
+    out = fa.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                          dropout_p=attn_dropout_rate,
+                                          is_causal=False, training=training)
+    out = apply(lambda o: o.reshape(b, s, nh * hd), out, _name="reshape")
+    proj_args = (out, linear_weight) + ((linear_bias,) if linear_bias is not None else ())
+    out = apply(lambda o, w, *bb: (o @ w) + (bb[0] if bb else 0), *proj_args,
+                _name="fused_out_proj")
+    out = dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = layer_norm(out, out.shape[-1:], weight=ln_scale, bias=ln_bias,
+                         epsilon=ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu", ln1_epsilon=1e-5,
+                      ln2_epsilon=1e-5, pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, add_residual=True,
+                      name=None):
+    """reference FusedFeedForward (`incubate/nn/layer/fused_transformer.py:534`)."""
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.nn.functional.common import dropout
+    from paddle_tpu.nn.functional.norm import layer_norm
+
+    residual = x
+    if pre_layer_norm:
+        x = layer_norm(x, x.shape[-1:], weight=ln1_scale, bias=ln1_bias,
+                       epsilon=ln1_epsilon)
+    act = getattr(F, activation)
+    h = apply(lambda a, w: a @ w, x, linear1_weight, _name="ffn1")
+    if linear1_bias is not None:
+        h = h + linear1_bias
+    h = act(h)
+    h = dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = apply(lambda a, w: a @ w, h, linear2_weight, _name="ffn2")
+    if linear2_bias is not None:
+        h = h + linear2_bias
+    h = dropout(h, p=dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        h = residual + h
+    if not pre_layer_norm:
+        h = layer_norm(h, h.shape[-1:], weight=ln2_scale, bias=ln2_bias,
+                       epsilon=ln2_epsilon)
+    return h

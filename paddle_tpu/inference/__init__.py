@@ -1,0 +1,190 @@
+"""paddle.inference: the deployment Predictor (config 5 of BASELINE).
+
+Reference: `paddle/fluid/inference/api/analysis_predictor.h:72` AnalysisPredictor,
+Python surface `python/paddle/inference/__init__.py:17-51`
+(Config/Predictor/create_predictor), bound at
+`paddle/fluid/pybind/inference_api.cc:1119`.
+
+TPU-native design: where the reference loads a ProgramDesc, runs IR fuse
+passes and interprets it (optionally handing subgraphs to TensorRT), this
+Predictor loads a **serialized StableHLO export** (`jax.export`) produced by
+`paddle_tpu.jit.save`, deserializes and (re)compiles it with PJRT for the
+local chip — XLA *is* the analysis/fusion pass stack. Weights ride in a
+separate .pdiparams pickle, passed as the first argument group so they stay
+resident on device across `run()` calls.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PredictorHandle", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+def get_version():
+    import paddle_tpu
+
+    return paddle_tpu.__version__
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM = 3  # TPU routes through the custom-device slot, as in the
+    # reference's CustomPlace (`paddle/fluid/pybind/inference_api.cc`)
+
+
+class Config:
+    """Subset of AnalysisConfig (`api/paddle_analysis_config.h`)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None and params_file is None:
+            # directory form: Config("path/prefix")
+            prog_file, params_file = prog_file + ".pdmodel", prog_file + ".pdiparams"
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._precision = PrecisionType.Float32
+        self._enable_memory_optim = True
+        self._cpu_math_threads = 1
+
+    # -- device selection ---------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._device, self._device_id = "tpu", device_id  # best device wins
+
+    def enable_custom_device(self, device_type="tpu", device_id=0,
+                             precision=PrecisionType.Float32):
+        self._device, self._device_id, self._precision = device_type, device_id, precision
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_model(self, prog_file, params_file=None):
+        if params_file is None:
+            prog_file, params_file = prog_file + ".pdmodel", prog_file + ".pdiparams"
+        self.prog_file, self.params_file = prog_file, params_file
+
+    def model_dir(self):
+        return os.path.dirname(self.prog_file or "")
+
+    # -- accepted no-ops (XLA already does these) ---------------------------
+    def enable_memory_optim(self, x=True):
+        self._enable_memory_optim = x
+
+    def switch_ir_optim(self, x=True):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._cpu_math_threads = n
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # XLA fusion replaces TRT subgraphs on TPU
+
+    def summary(self):
+        return (f"Config(prog={self.prog_file}, params={self.params_file}, "
+                f"device={self._device})")
+
+
+class PredictorHandle:
+    """Input/output handle (reference ZeroCopyTensor,
+    `paddle/fluid/inference/api/details/zero_copy_tensor.cc`)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._array = None
+
+    def reshape(self, shape):
+        pass  # shapes come from the bound array
+
+    def copy_from_cpu(self, arr):
+        self._array = np.ascontiguousarray(arr)
+
+    def share_external_data(self, arr):
+        self._array = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._array)
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+
+class Predictor:
+    """Compiled predictor over a StableHLO export."""
+
+    def __init__(self, config: Config):
+        import jax
+        from jax import export as jax_export
+
+        self.config = config
+        with open(config.prog_file, "rb") as f:
+            meta = pickle.load(f)
+        with open(config.params_file, "rb") as f:
+            state = pickle.load(f)
+        if not isinstance(meta, dict) or "stablehlo" not in meta:
+            raise ValueError(
+                f"{config.prog_file} has no serialized program; re-save with "
+                "paddle_tpu.jit.save(layer, path, input_spec=[...])")
+        self._exported = jax_export.deserialize(meta["stablehlo"])
+        self._input_names = meta["input_names"]
+        self._output_names = meta.get("output_names") or ["output_0"]
+        self._param_keys = meta["param_keys"]
+        if config._device == "cpu":
+            dev = jax.devices("cpu")[0]
+        else:
+            dev = jax.devices()[config._device_id]
+        self._params = [jax.device_put(state[k], dev) for k in self._param_keys]
+        self._inputs = {n: PredictorHandle(n) for n in self._input_names}
+        self._outputs = {n: PredictorHandle(n) for n in self._output_names}
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """AnalysisPredictor::Run / ZeroCopyRun (`analysis_predictor.cc:1574,2577`)."""
+        if inputs is not None:  # positional list form
+            for h, arr in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(np.asarray(arr))
+        args = self._params + [self._inputs[n]._array for n in self._input_names]
+        out = self._exported.call(*args)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        if len(outs) != len(self._output_names):
+            # older saves lacked output_names; never drop outputs
+            self._output_names = [f"output_{i}" for i in range(len(outs))]
+            self._outputs = {n: PredictorHandle(n) for n in self._output_names}
+        results = []
+        for name, o in zip(self._output_names, outs):
+            a = np.asarray(o)
+            self._outputs[name]._array = a
+            results.append(a)
+        return results
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

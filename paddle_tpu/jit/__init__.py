@@ -185,18 +185,82 @@ class TracedLayer:
 
 
 def save(layer, path, input_spec=None, **configs):
-    """jit.save (reference `jit/api.py:955`): persist weights + a program
-    descriptor; the TPU inference Predictor reloads and recompiles."""
+    """jit.save (reference `jit/api.py:955`): persist weights + program.
+
+    TPU-native format: the program is the layer's forward traced to
+    **StableHLO** via `jax.export` (multi-platform cpu+tpu), the weights a
+    pickle of numpy arrays. `paddle_tpu.inference.create_predictor` reloads
+    and recompiles with PJRT — the XLA analogue of the reference's
+    save_inference_model -> AnalysisPredictor pipeline
+    (`python/paddle/static/io.py:513`, `api/analysis_predictor.cc`).
+    Without input_spec only the weights are saved (state-dict style).
+    """
     import os
     import pickle
+
+    import numpy as np
 
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     target = layer._layer if isinstance(layer, StaticFunction) else layer
     state = {k: v.numpy() for k, v in target.state_dict().items()}
-    meta = {
-        "class": type(target).__name__,
-        "input_spec": input_spec,
-    }
+    meta = {"class": type(target).__name__}
+
+    if input_spec is not None:
+        from jax import export as jax_export
+
+        pure_fn, params, buffers = functionalize(target)
+        param_keys = list(params.keys())
+        input_names = []
+        shape_structs = []
+        # dynamic dims (None/-1) become jax.export symbolic dimensions so the
+        # reloaded Predictor accepts any batch size, like the reference's
+        # -1 dims in save_inference_model
+        scope = jax_export.SymbolicScope()
+        n_sym = 0
+        for i, spec in enumerate(input_spec):
+            dims = []
+            for d in list(spec.shape):
+                if d is None or d == -1:
+                    dims.append(f"dyn{n_sym}")
+                    n_sym += 1
+                else:
+                    dims.append(str(int(d)))
+            from paddle_tpu.framework import dtypes as _dt
+
+            dt = _dt.convert_dtype(getattr(spec, "dtype", "float32"))
+            input_names.append(getattr(spec, "name", None) or f"input_{i}")
+            if any(not d.isdigit() for d in dims):
+                shape = jax_export.symbolic_shape(",".join(dims), scope=scope)
+            else:
+                shape = tuple(int(d) for d in dims)
+            shape_structs.append(jax.ShapeDtypeStruct(shape, dt))
+
+        key = jax.random.key(0)
+        was_training = getattr(target, "training", False)
+        target.eval()
+        try:
+            def infer_fn(*flat):
+                ps = dict(zip(param_keys, flat[:len(param_keys)]))
+                out, _ = pure_fn(ps, buffers, key, *flat[len(param_keys):])
+                return out
+
+            param_structs = [jax.ShapeDtypeStruct(v.shape, v.dtype)
+                             for v in params.values()]
+            exported = jax_export.export(
+                jax.jit(infer_fn), platforms=("cpu", "tpu"))(
+                    *param_structs, *shape_structs)
+        finally:
+            if was_training:
+                target.train()
+        meta.update({
+            "stablehlo": exported.serialize(),
+            "input_names": input_names,
+            "output_names": [f"output_{i}"
+                             for i in range(len(exported.out_avals))],
+            "param_keys": param_keys,
+        })
+        state = {k: np.asarray(v) for k, v in params.items()}
+
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f)
     with open(path + ".pdmodel", "wb") as f:

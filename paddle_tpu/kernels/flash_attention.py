@@ -64,21 +64,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, causal, block_q,
     if causal:
         # only k blocks at or left of this q block's (offset) diagonal
         diag_end = q_start + block_q + (seq_k - seq_q)
-        num_kb = jnp.minimum((diag_end + block_k - 1) // block_k,
-                             seq_k // block_k)
+        num_kb = jnp.clip((diag_end + block_k - 1) // block_k, 0,
+                          seq_k // block_k)
     else:
         num_kb = seq_k // block_k
     acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # rows with no visible keys (sq > sk fully-masked tail) produce l == 0
+    o_ref[0] = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0),
+                         0.0).astype(o_ref.dtype)
+
+
+def _pick_block(seq, preferred):
+    """Largest power-of-two block <= preferred that divides seq."""
+    b = preferred
+    while b > 128 and seq % b != 0:
+        b //= 2
+    return b
 
 
 def _flash_fwd_bhsd(q, k, v, causal, sm_scale, block_q=256, block_k=256,
                     interpret=False):
-    """q,k,v: [BH, S, D] -> out [BH, S, D]."""
+    """q,k,v: [BH, S, D] -> out [BH, S, D]. seq lengths must be multiples
+    of 128 (the caller guards and falls back otherwise)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    block_q = _pick_block(sq, min(block_q, sq))
+    block_k = _pick_block(sk, min(block_k, sk))
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                              block_q=block_q, block_k=block_k, seq_q=sq,
                              seq_k=sk)
@@ -97,14 +108,11 @@ def _flash_fwd_bhsd(q, k, v, causal, sm_scale, block_q=256, block_k=256,
 
 
 def _sdpa_xla(q, k, v, causal, sm_scale):
-    """Reference attention in [b, s, h, d]; used for the backward pass."""
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
-    if causal:
-        sq, sk = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        logits = jnp.where(mask, logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    """Reference attention in [b, s, h, d]; used for the backward pass.
+    Single source of truth lives in nn.functional.flash_attention."""
+    from paddle_tpu.nn.functional.flash_attention import _sdpa_reference
+
+    return _sdpa_reference(q, k, v, causal=causal, scale=sm_scale)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

@@ -135,10 +135,13 @@ class LlamaRotaryEmbedding(nn.Layer):
         self.head_dim = head_dim
         self.max_position_embeddings = max_position_embeddings
         self.theta = theta
+        self._cache = {}  # seq_len -> (cos Tensor, sin Tensor), float32
 
     def forward(self, seq_len):
-        cos, sin = _rope_tables(seq_len, self.head_dim, self.theta)
-        return Tensor(cos), Tensor(sin)  # float32 tables
+        if seq_len not in self._cache:
+            cos, sin = _rope_tables(seq_len, self.head_dim, self.theta)
+            self._cache[seq_len] = (Tensor(cos), Tensor(sin))
+        return self._cache[seq_len]
 
 
 class LlamaAttention(nn.Layer):
@@ -190,13 +193,12 @@ class LlamaAttention(nn.Layer):
         offset = 0
         if past_key_value is not None:
             offset = past_key_value[0].shape[1]
-        theta, hd = self.config.rope_theta, self.head_dim
+        cos_t, sin_t = self.rotary_emb(offset + s)  # cached tables
 
-        def rope_fn(qd, kd):
-            cos, sin = _rope_tables(offset + s, hd, theta)
+        def rope_fn(qd, kd, cos, sin):
             return _apply_rope(qd, kd, cos[offset:], sin[offset:])
 
-        q, k = apply(rope_fn, q, k, _name="fused_rope")
+        q, k = apply(rope_fn, q, k, cos_t, sin_t, _name="fused_rope")
 
         if past_key_value is not None:
             k = paddle.concat([past_key_value[0], k], axis=1)
@@ -208,14 +210,16 @@ class LlamaAttention(nn.Layer):
             k = paddle.repeat_interleave(k, rep, axis=2)
             v = paddle.repeat_interleave(v, rep, axis=2)
 
-        causal = past_key_value is None
+        # causal always holds; with a KV cache the offset diagonal
+        # tril(k=sk-sq) lets the query chunk at positions [offset, offset+s)
+        # see all cached keys while staying causal within the chunk
         if self.config.use_flash_attention and attention_mask is None:
-            out = flash_attn_mod.flash_attention(q, k, v, causal=causal)[0]
+            out = flash_attn_mod.flash_attention(q, k, v, causal=True)[0]
         else:
             # causality is kept even with a user mask (the reference folds the
             # padding mask into the causal mask before attention)
             out = flash_attn_mod.scaled_dot_product_attention(
-                q, k, v, attn_mask=attention_mask, is_causal=causal)
+                q, k, v, attn_mask=attention_mask, is_causal=True)
         out = paddle.reshape(out, [b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if use_cache:

@@ -1,0 +1,193 @@
+"""paddle.profiler: tracing facade over JAX/XLA profiling.
+
+Reference: `python/paddle/profiler/profiler.py` (Profiler context manager,
+scheduler, chrome-trace export), C++ side `paddle/fluid/platform/profiler/`
+(host tracer + CUPTI + chrome logger, entered via RecordEvent brackets in
+every generated API, `api_base.py:1356`).
+
+TPU-native design: device-side tracing is XLA/xprof (`jax.profiler`), which
+captures both host activity and TPU timelines; `RecordEvent` maps to
+`jax.profiler.TraceAnnotation` so user annotations appear in the same
+timeline. A lightweight host-side event table backs `summary()`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from enum import Enum
+
+__all__ = [
+    "Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+    "SortedKeys", "SummaryView",
+]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3  # TPU
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SortedKeys(Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    GPUTotal = 3
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Step-state schedule (reference profiler.make_scheduler)."""
+    period = closed + ready + record
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = (step - skip_first) % max(period, 1)
+        if s < closed:
+            return ProfilerState.CLOSED
+        if s < closed + ready:
+            return ProfilerState.READY
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+_events = defaultdict(list)  # name -> [durations]
+
+
+class RecordEvent:
+    """User annotation (reference `profiler/utils.py` RecordEvent): shows up
+    in the xprof timeline and the host summary table."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def begin(self):
+        import jax
+
+        self._t0 = time.perf_counter()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            _events[self.name].append(time.perf_counter() - self._t0)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+
+    return handler
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("open the xprof dump with tensorboard/xprof")
+
+
+class Profiler:
+    """reference `profiler/profiler.py` Profiler: start/stop/step, xprof dump
+    to `log_dir` readable by tensorboard/xprof."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False, log_dir="./profiler_log"):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.CUSTOM_DEVICE]
+        self.scheduler = scheduler if callable(scheduler) else None
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.log_dir = log_dir
+        self.current_state = ProfilerState.CLOSED
+        self._step = 0
+        self._tracing = False
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        self._last_step_t = time.perf_counter()
+        if not self.timer_only:
+            import jax
+
+            try:
+                jax.profiler.start_trace(self.log_dir)
+                self._tracing = True
+            except Exception:
+                self._tracing = False
+        self.current_state = ProfilerState.RECORD
+
+    def stop(self):
+        if self._tracing:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._tracing = False
+        self.current_state = ProfilerState.CLOSED
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        avg = sum(self._step_times) / len(self._step_times)
+        return f"avg step time {avg * 1e3:.2f} ms over {len(self._step_times)} steps"
+
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
+                thread_sep=False, time_unit="ms"):
+        lines = [f"{'event':<40}{'calls':>8}{'total(ms)':>12}{'avg(ms)':>12}"]
+        items = sorted(_events.items(),
+                       key=lambda kv: -sum(kv[1]))
+        for name, durs in items:
+            lines.append(f"{name:<40}{len(durs):>8}"
+                         f"{sum(durs) * 1e3:>12.3f}"
+                         f"{sum(durs) / len(durs) * 1e3:>12.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,194 @@
+"""paddle.sparse: COO/CSR sparse tensors over jax.experimental.sparse.
+
+Reference: `paddle/phi/core/sparse_coo_tensor.h`, `sparse_csr_tensor.h`,
+kernels `paddle/phi/kernels/sparse/`, Python `python/paddle/sparse/`.
+
+TPU-native design: sparse compute on TPU lowers to dense-friendly BCOO
+(batched COO) ops that XLA can tile; `jax.experimental.sparse.BCOO` is the
+storage. CSR is stored as BCOO internally with the CSR view materialised on
+demand (TPU has no native CSR gather; the reference's cuSPARSE calls have no
+ICI analogue).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "is_same_shape",
+    "matmul", "add", "multiply", "subtract", "divide", "relu", "transpose",
+    "SparseCooTensor", "SparseCsrTensor",
+]
+
+
+class SparseCooTensor(Tensor):
+    """Tensor whose _data is dense only on demand; holds a BCOO."""
+
+    __slots__ = ("_bcoo",)
+
+    def __init__(self, bcoo, stop_gradient=True):
+        self._bcoo = bcoo
+        super().__init__(jnp.zeros((), jnp.float32), stop_gradient=stop_gradient)
+        self._data = None  # dense view is lazy
+
+    # -- sparse surface (reference python/paddle/sparse/creation.py) -------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        return SparseCsrTensor(self._bcoo)
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor(SparseCooTensor):
+    """CSR view over BCOO storage (2-D only)."""
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def _csr(self):
+        rows = np.asarray(self._bcoo.indices[:, 0])
+        n_rows = self.shape[0]
+        crows = np.zeros(n_rows + 1, np.int64)
+        np.add.at(crows[1:], rows, 1)
+        return np.cumsum(crows), np.asarray(self._bcoo.indices[:, 1])
+
+    def crows(self):
+        return Tensor(self._csr()[0])
+
+    def cols(self):
+        return Tensor(self._csr()[1])
+
+    def to_sparse_coo(self, sparse_dim=2):
+        return SparseCooTensor(self._bcoo)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def _as_array(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """reference `python/paddle/sparse/creation.py` sparse_coo_tensor;
+    indices: [sparse_dim, nnz]."""
+    idx = np.asarray(indices.numpy() if isinstance(indices, Tensor) else indices)
+    vals = _as_array(values)
+    if dtype is not None:
+        from paddle_tpu.framework import dtypes
+
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    idx = np.stack([rows, cols])
+    t = sparse_coo_tensor(idx, values, shape, dtype, place, stop_gradient)
+    return SparseCsrTensor(t._bcoo)
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def matmul(x, y, name=None):
+    """spmm: sparse @ dense (reference paddle.sparse.matmul)."""
+    if isinstance(x, SparseCooTensor):
+        yd = _as_array(y)
+        return Tensor(x._bcoo @ yd)
+    xd = _as_array(x)
+    return Tensor(xd @ y._bcoo.todense())
+
+
+def _ewise(op, x, y):
+    xs = x._bcoo.todense() if isinstance(x, SparseCooTensor) else _as_array(x)
+    ys = y._bcoo.todense() if isinstance(y, SparseCooTensor) else _as_array(y)
+    out = op(xs, ys)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def add(x, y, name=None):
+    return _ewise(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _ewise(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _ewise(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return _ewise(jnp.divide, x, y)
+
+
+def relu(x, name=None):
+    bcoo = jsparse.BCOO((jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+                        shape=x._bcoo.shape)
+    return type(x)(bcoo)
+
+
+def transpose(x, perm, name=None):
+    dense = jnp.transpose(x._bcoo.todense(), perm)
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense))
+
+
+class nn:
+    """paddle.sparse.nn subset (ReLU)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

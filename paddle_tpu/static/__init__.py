@@ -1,0 +1,198 @@
+"""paddle.static: static-graph user API surface.
+
+Reference: `python/paddle/static/` (Program/program_guard/data/Executor/
+save_inference_model, `static/io.py:513`).
+
+TPU-native design: the reference's static graph is a ProgramDesc interpreted
+by `PirInterpreter` (`pir_interpreter.cc:1492`). Under XLA the natural
+"static program" is a traced+compiled function, so this module maps the
+static API onto jit tracing: `InputSpec` describes placeholders,
+`save_inference_model` exports StableHLO via `paddle_tpu.jit.save`, and
+`load_inference_model`/`Executor.run` execute through the inference
+Predictor. Program/program_guard are accepted for source compatibility and
+behave as an eager scope (every op executed under them runs eagerly; the
+compiled path is `paddle_tpu.jit.to_static`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "InputSpec", "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "global_scope", "scope_guard",
+    "save_inference_model", "load_inference_model", "name_scope", "cpu_places",
+    "device_guard",
+]
+
+
+class InputSpec:
+    """Placeholder spec (reference `python/paddle/static/input.py`)."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class Program:
+    """Source-compat Program object; ops under its guard run eagerly."""
+
+    def __init__(self):
+        self._feed_names = []
+        self._fetch = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def all_parameters(self):
+        return []
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class device_guard(name_scope):
+    pass
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Placeholder: returns a zero Tensor of the given shape (dims of -1/None
+    become 1), usable to trace shapes eagerly."""
+    import paddle_tpu as paddle
+
+    shp = [1 if (d is None or int(d) < 0) else int(d) for d in shape]
+    t = paddle.zeros(shp, dtype=dtype)
+    t.name = name
+    return t
+
+
+def cpu_places(device_count=None):
+    import jax
+
+    return jax.devices("cpu")[: (device_count or 1)]
+
+
+class _Scope:
+    def __init__(self):
+        self.vars = {}
+
+
+_scope = _Scope()
+
+
+def global_scope():
+    return _scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class Executor:
+    """Source-compat Executor (reference `base/executor.py:1734` Executor.run).
+
+    With the eager/XLA substrate there is no ProgramDesc to interpret: `run`
+    on a loaded inference program dispatches to the compiled Predictor."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._predictor = None
+
+    def run(self, program=None, feed=None, fetch_list=None, **kw):
+        if isinstance(program, _LoadedInferenceProgram):
+            return program.run(feed or {})
+        if callable(program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+        raise ValueError(
+            "Executor.run needs a loaded inference program "
+            "(load_inference_model) or a callable; build compiled graphs with "
+            "paddle_tpu.jit.to_static")
+
+    def close(self):
+        pass
+
+
+class _LoadedInferenceProgram:
+    def __init__(self, path_prefix):
+        from paddle_tpu.inference import Config, create_predictor
+
+        self._predictor = create_predictor(Config(path_prefix))
+        self.feed_names = self._predictor.get_input_names()
+        self.fetch_names = self._predictor.get_output_names()
+
+    def run(self, feed):
+        ins = [np.asarray(feed[n]) for n in self.feed_names]
+        return self._predictor.run(ins)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kw):
+    """reference `static/io.py:513`. Here: the model must be a Layer passed
+    via kw['layer'] or a to_static-decorated function; exports StableHLO."""
+    layer = kw.get("layer")
+    if layer is None:
+        raise ValueError(
+            "TPU save_inference_model exports a Layer: "
+            "save_inference_model(path, feed_vars, fetch_vars, layer=my_layer) "
+            "— or use paddle_tpu.jit.save(layer, path, input_spec=...)")
+    from paddle_tpu import jit as pjit
+
+    specs = [InputSpec(v.shape, str(v.dtype), getattr(v, "name", None))
+             for v in feed_vars]
+    pjit.save(layer, path_prefix, input_spec=specs)
+
+
+def load_inference_model(path_prefix, executor=None, **kw):
+    prog = _LoadedInferenceProgram(path_prefix)
+    return prog, prog.feed_names, prog.fetch_names

@@ -44,6 +44,16 @@ def test_cross_length_causal():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-2)
 
 
+def test_seq_384_not_multiple_of_block():
+    """seq % 128 == 0 but % 256 != 0 must shrink the block, not drop rows."""
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.normal(size=(1, 384, 2, 64)), jnp.float32)
+    out = _flash_attention(q, q, q, True, 0.125, _INTERPRET)
+    ref = _sdpa_xla(q, q, q, True, 0.125)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-2)
+
+
 def test_unaligned_seq_falls_back():
     rng = np.random.default_rng(2)
     q = jnp.asarray(rng.normal(size=(1, 100, 2, 64)), jnp.float32)

@@ -55,6 +55,19 @@ def test_generate_with_kv_cache():
     assert out.shape == [1, 8]
 
 
+def test_chunked_prefill_matches_full_forward():
+    """Multi-token chunks through the KV cache must stay causal."""
+    cfg = _cfg(use_flash_attention=False)
+    model = LlamaForCausalLM(cfg)
+    ids = paddle.to_tensor(np.random.default_rng(2).integers(0, 128, (1, 12)),
+                           dtype="int32")
+    full = model(ids).numpy()
+    logits1, past = model(ids[:, :8], use_cache=True)
+    logits2, _ = model(ids[:, 8:], past_key_values=past, use_cache=True)
+    chunked = np.concatenate([logits1.numpy(), logits2.numpy()], axis=1)
+    np.testing.assert_allclose(chunked, full, atol=1e-4)
+
+
 def test_functional_matches_shapes():
     cfg = _cfg()
     args = lf.LlamaArgs.from_config(cfg)

@@ -1,0 +1,95 @@
+"""Native C++ runtime core: TCPStore rendezvous, flags registry, watchdog."""
+
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.core import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core not built")
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_tcp_store_set_get_add_wait():
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    worker = native.TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+
+    master.set("addr", "10.0.0.1:8471")
+    assert worker.get("addr") == b"10.0.0.1:8471"
+
+    assert worker.add("counter", 3) == 3
+    assert master.add("counter", 2) == 5
+
+    with pytest.raises(RuntimeError):
+        worker.get("missing_key", timeout=0.3)
+
+    master.set("ready", "1")
+    worker.wait("ready", timeout=2.0)
+
+
+def test_tcp_store_barrier_across_clients():
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    worker = native.TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+
+    errs = []
+
+    def rank1():
+        try:
+            time.sleep(0.2)  # master enters the barrier first and must wait
+            worker.barrier("init", rank=1, world_size=2, timeout=5.0)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    th = threading.Thread(target=rank1)
+    th.start()
+    master.barrier("init", rank=0, world_size=2, timeout=5.0)
+    th.join()
+    assert not errs
+
+
+def test_tcp_store_barrier_timeout():
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    with pytest.raises(RuntimeError, match="barrier"):
+        master.barrier("lonely", rank=0, world_size=2, timeout=0.5)
+
+
+def test_flags_native_registry(monkeypatch):
+    native.flags_set("check_nan_inf", "true")
+    assert native.flags_get("check_nan_inf") == "true"
+    monkeypatch.setenv("FLAGS_from_env_flag", "42")
+    assert native.flags_get("from_env_flag") == "42"
+
+
+def test_watchdog_fires_on_timeout():
+    fired = []
+    wd = native.Watchdog(poll_interval=0.1,
+                         on_timeout=lambda name, ms: fired.append((name, ms)))
+    wd.begin("allreduce_step", timeout=0.2)
+    time.sleep(1.0)
+    wd.stop()
+    assert fired and fired[0][0] == "allreduce_step"
+
+
+def test_watchdog_no_fire_when_ended():
+    fired = []
+    wd = native.Watchdog(poll_interval=0.1,
+                         on_timeout=lambda name, ms: fired.append(name))
+    wd.begin("quick_task", timeout=5.0)
+    wd.end("quick_task")
+    time.sleep(0.5)
+    wd.stop()
+    assert not fired

@@ -1,0 +1,214 @@
+"""inference / static / profiler / incubate / sparse / checkpoint / launch."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+# -- inference predictor ------------------------------------------------------
+
+def test_jit_save_inference_roundtrip(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    prefix = str(tmp_path / "model")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([2, 8], "float32", "x")])
+    cfg = Config(prefix)
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    x = np.random.default_rng(0).normal(size=(2, 8)).astype("float32")
+    out = pred.run([x])[0]
+    ref = m(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_predictor_dynamic_batch_and_multi_output(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(8, 4)
+            self.b = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    m = TwoHead()
+    prefix = str(tmp_path / "twohead")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([None, 8], "float32", "x")])
+    cfg = Config(prefix)
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    assert len(pred.get_output_names()) == 2
+    for bs in (1, 3, 7):  # dynamic batch via symbolic export dims
+        x = np.random.default_rng(bs).normal(size=(bs, 8)).astype("float32")
+        outs = pred.run([x])
+        assert outs[0].shape == (bs, 4) and outs[1].shape == (bs, 2)
+        np.testing.assert_allclose(outs[0], m(paddle.to_tensor(x))[0].numpy(),
+                                   atol=1e-5)
+
+
+def test_static_save_load_inference_model(tmp_path):
+    from paddle_tpu import static
+
+    m = nn.Linear(4, 2)
+    prefix = str(tmp_path / "static_model")
+    x = static.data("x", [1, 4], "float32")
+    static.save_inference_model(prefix, [x], [], layer=m)
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    exe = static.Executor()
+    xin = np.ones((1, 4), np.float32)
+    out = exe.run(prog, feed={"x": xin})[0]
+    ref = m(paddle.to_tensor(xin)).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+# -- profiler -----------------------------------------------------------------
+
+def test_profiler_records_and_summarizes(capsys):
+    import paddle_tpu.profiler as profiler
+
+    with profiler.RecordEvent("unit_test_event"):
+        _ = paddle.matmul(paddle.randn([8, 8]), paddle.randn([8, 8]))
+    p = profiler.Profiler(timer_only=True)
+    p.start()
+    p.step()
+    p.step()
+    p.stop()
+    assert "avg step time" in p.step_info()
+    table = p.summary()
+    assert "unit_test_event" in table
+
+
+# -- incubate -----------------------------------------------------------------
+
+def test_fused_transformer_encoder_layer():
+    from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+    layer = FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+    x = paddle.randn([2, 8, 32])
+    y = layer(x)
+    assert y.shape == [2, 8, 32]
+    y.sum().backward()
+
+
+def test_swiglu():
+    from paddle_tpu.incubate.nn.functional import swiglu
+
+    x = paddle.randn([4, 8])
+    y = paddle.randn([4, 8])
+    out = swiglu(x, y)
+    ref = (x.numpy() / (1 + np.exp(-x.numpy()))) * y.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+def test_moe_layer_gates():
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    x = paddle.randn([2, 8, 32])
+    for gate in ("gshard", "switch", "naive"):
+        moe = MoELayer(d_model=32, d_hidden=64, num_expert=4, top_k=2,
+                       gate=gate)
+        y = moe(x)
+        assert y.shape == [2, 8, 32]
+        if gate != "naive":
+            assert float(moe.gate.loss) > 0
+        (y.sum()).backward()
+
+
+# -- sparse -------------------------------------------------------------------
+
+def test_sparse_coo_roundtrip():
+    sp = paddle.sparse.sparse_coo_tensor([[0, 1, 2], [1, 0, 2]],
+                                         [1.0, 2.0, 3.0], (3, 3))
+    dense = sp.to_dense().numpy()
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 0], expect[2, 2] = 1, 2, 3
+    np.testing.assert_allclose(dense, expect)
+    assert sp.nnz() == 3
+
+
+def test_sparse_matmul_and_csr():
+    sp = paddle.sparse.sparse_coo_tensor([[0, 1], [1, 0]], [2.0, 3.0], (2, 2))
+    d = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    out = paddle.sparse.matmul(sp, d).numpy()
+    np.testing.assert_allclose(out, [[0, 2], [3, 0]])
+    csr = sp.to_sparse_csr()
+    assert csr.crows().numpy().tolist() == [0, 1, 2]
+    r = paddle.sparse.relu(paddle.sparse.sparse_coo_tensor(
+        [[0], [0]], [-1.0], (1, 1)))
+    assert r.values().numpy()[0] == 0.0
+
+
+# -- distributed checkpoint ---------------------------------------------------
+
+def test_checkpoint_roundtrip_with_reshard(tmp_path):
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+    from paddle_tpu.distributed.process_mesh import ProcessMesh
+    from paddle_tpu.distributed.placement import Replicate, Shard
+
+    n = jax.device_count()
+    mesh_a = ProcessMesh(np.arange(n).reshape(2, n // 2), ["x", "y"])
+    mesh_b = ProcessMesh(np.arange(n).reshape(n // 2, 2), ["x", "y"])
+
+    w = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(8, 4))
+    w_sharded = dist.shard_tensor(w, mesh_a, [Shard(0), Replicate()])
+    state = {"layer": {"weight": w_sharded}}
+    save_state_dict(state, str(tmp_path / "ckpt"))
+
+    # load into a DIFFERENT sharding (reshard-on-load)
+    w2 = dist.shard_tensor(paddle.zeros([8, 4]), mesh_b, [Replicate(), Shard(1)])
+    target = {"layer": {"weight": w2}}
+    load_state_dict(target, str(tmp_path / "ckpt"))
+    np.testing.assert_allclose(w2.numpy(), w.numpy())
+    # destination sharding preserved
+    assert "y" in str(w2._data.sharding.spec)
+
+
+def test_checkpoint_async_save(tmp_path):
+    from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+    state = {"w": paddle.to_tensor(np.ones((4, 4), np.float32))}
+    th = save_state_dict(state, str(tmp_path / "ck2"), async_save=True)
+    th.join()
+    tgt = {"w": paddle.zeros([4, 4])}
+    load_state_dict(tgt, str(tmp_path / "ck2"))
+    np.testing.assert_allclose(tgt["w"].numpy(), 1.0)
+
+
+def test_checkpoint_missing_tensor_raises(tmp_path):
+    from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
+
+    save_state_dict({"a": paddle.zeros([2])}, str(tmp_path / "ck3"))
+    with pytest.raises(ValueError):
+        load_state_dict({"b": paddle.zeros([2])}, str(tmp_path / "ck3"))
+
+
+# -- launch CLI ---------------------------------------------------------------
+
+def test_launch_single_node(tmp_path):
+    import subprocess
+    import sys
+
+    script = tmp_path / "train_stub.py"
+    script.write_text(
+        "import os\n"
+        "assert os.environ['PADDLE_TRAINER_ID'] == '0'\n"
+        "assert os.environ['PADDLE_TRAINERS_NUM'] == '1'\n"
+        "print('LAUNCH_STUB_OK')\n")
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch", str(script)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert "LAUNCH_STUB_OK" in out.stdout, out.stderr
