@@ -8,15 +8,13 @@ var), so we must override the *config* back to cpu before any backend init.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from __graft_entry__ import _force_cpu_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+_force_cpu_platform(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
