@@ -1,0 +1,40 @@
+"""Virtual-device platform setup for tests and multi-chip dry runs.
+
+The TPU build is validated on a virtual N-device CPU mesh (the reference's
+fake-device rig, `test/custom_runtime/test_custom_cpu_plugin.py:27-47`: a CPU
+masquerading as the accelerator drives the same code paths). This module lives at the repo root (NOT inside paddle_tpu/) on purpose — it
+must be importable BEFORE any JAX backend init, and importing the paddle_tpu
+package initializes the backend as a side effect of building the eager op
+surface.
+
+Note: the session's sitecustomize may register an out-of-tree PJRT plugin and
+force-set jax_platforms via jax.config (overriding the env var), so we
+override the *config* back to cpu as well as the env.
+"""
+
+import os
+import re
+
+__all__ = ["force_cpu_platform"]
+
+
+def force_cpu_platform(n_devices: int) -> None:
+    """Force a virtual n-device CPU platform. Must run before the JAX backend
+    initializes — afterwards the flags are a no-op (callers should check
+    ``jax.devices('cpu')`` and error with guidance)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        flags += f" --xla_force_host_platform_device_count={n_devices}"
+    elif int(m.group(1)) < n_devices:
+        flags = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized; jax.devices('cpu') still works

@@ -216,6 +216,15 @@ class HybridParallelEngine:
         hd = args.hidden_size // args.num_heads
         cos, sin = lf.rope_tables(s_len, hd, args.rope_theta)
 
+        # embedding/lm_head/final_norm are replicated over 'pp' but used only
+        # inside stage-gated conds. pvary them HERE (outside the conds) so the
+        # vjp's cotangent psum over 'pp' — which sums the real grad from the
+        # owning stage with zeros from the others — runs uniformly on every
+        # stage instead of deadlocking inside a divergent branch.
+        lp = dict(lp)
+        for k in ("embedding", "lm_head", "final_norm"):
+            lp[k] = jax.lax.pcast(lp[k], ("pp",), to="varying")
+
         def stage_fn(h):
             return lf.run_layers(lp["layers"], h, cos, sin, args, mp_axis, mp,
                                  sp, self.remat)
@@ -247,44 +256,63 @@ class HybridParallelEngine:
             else:
                 h_recv = h_prev
             in_idx = jnp.clip(t, 0, M - 1)
-            # gate embed/head on the owning stage with lax.cond so the other
-            # stages skip the vocab-sized matmuls entirely; stage index is
-            # uniform across 'mp' ranks, so the mp collectives inside stay
-            # SPMD-consistent
+            # Gate embed/head on the owning stage with lax.cond so the other
+            # stages skip the vocab-sized matmuls entirely. The predicate is
+            # pp-varying, so branches must not contain 'pp' collectives (their
+            # participants would diverge and deadlock) — 'dp'/'mp' collectives
+            # are safe because those groups share the stage index. The
+            # zero-scaled adds tie the branch outputs to h_recv/h_out's vma
+            # type without introducing a collective in forward or vjp.
             h_in = jax.lax.cond(stage == 0,
-                                lambda op: embed_mb(op[1]),
+                                lambda op: embed_mb(op[1]) + op[0] * 0,
                                 lambda op: op[0], (h_recv, in_idx))
             h_out = stage_fn(h_in)
             out_idx = t - (S - 1)
+
+            def zero_loss(op):
+                z = jnp.sum(op[0]).astype(jnp.float32) * 0
+                if sp and mp_axis:
+                    z = jax.lax.psum(z, mp_axis)
+                return z
+
             contrib = jax.lax.cond(
                 (stage == S - 1) & (out_idx >= 0),
                 lambda op: head_loss(op[0], jnp.clip(op[1], 0, M - 1)),
-                lambda op: jnp.zeros((), jnp.float32), (h_out, out_idx))
+                zero_loss, (h_out, out_idx))
             return h_out, contrib
 
         mb_local = ids.shape[1]
         seq_local = s_len // mp if (sp and mp_axis) else s_len
         h0 = jnp.zeros((mb_local, seq_local, args.hidden_size), self.dtype)
+        # the scan carry becomes device-varying after one step (data over
+        # 'dp', stage-gated compute over 'pp', seq shards over 'mp' under
+        # SP); pvary the zero carry up-front so the vma type is stable
+        vary_axes = ("dp", "pp") + (("mp",) if (sp and mp_axis) else ())
+        h0 = jax.lax.pcast(h0, vary_axes, to="varying")
         _, losses = jax.lax.scan(step, h0, jnp.arange(M + S - 1))
-        total = jnp.sum(losses) / M
-        if S > 1:
-            total = jax.lax.psum(total, "pp")  # only last stage contributed
+        # Scale by 1/dp so this is each rank's *contribution to the global
+        # mean* loss. Params arrive dp-invariant, so their implicit pvary at
+        # first use transposes to a psum over 'dp' — the vjp therefore SUMS
+        # grads across dp ranks (the reference's EagerReducer allreduce,
+        # reducer.cc:1089); with the 1/dp here that sum is the global-mean
+        # gradient, no post-hoc pmean (which would double-scale) needed.
+        total = jnp.sum(losses) / (M * self.dp)
+        # stage-gated cond makes the loss pp-varying even at pp=1; psum
+        # collapses it (only the last stage contributed non-zeros)
+        total = jax.lax.psum(total, "pp")
         return total
 
     def _local_grads(self, lp, ids, labels):
+        """Loss + grads with collective transposition handled by the vma type
+        system (check_vma=True): forward psum/all_gather/psum_scatter
+        transpose to pvary/psum_scatter/all_gather, so TP/SP weight grads come
+        out correct with no manual fix-ups (the pvary transposes even cover
+        the stage-gated embedding/head/final-norm psum over 'pp'). The only
+        reduction left for us is dp grad averaging (the reference's
+        EagerReducer allreduce, reducer.cc:1089)."""
         loss, grads = jax.value_and_grad(self._pipeline_loss)(lp, ids, labels)
-        if self.dp > 1:
-            grads = jax.lax.pmean(grads, "dp")
-            loss = jax.lax.pmean(loss, "dp")
-        if self.pp > 1:
-            # embedding/lm_head/final_norm live on one stage; others saw zeros
-            for k in ("embedding", "lm_head", "final_norm"):
-                grads[k] = jax.lax.psum(grads[k], "pp")
-        if self.sp and self.mp > 1:
-            # norm weights see seq-local activations: partial grads over 'mp'
-            grads["final_norm"] = jax.lax.psum(grads["final_norm"], "mp")
-            grads["layers"]["ln1"] = jax.lax.psum(grads["layers"]["ln1"], "mp")
-            grads["layers"]["ln2"] = jax.lax.psum(grads["layers"]["ln2"], "mp")
+        # loss is this rank's 1/dp-scaled contribution: psum = global mean
+        loss = jax.lax.psum(loss, "dp")
         return loss, grads
 
     # -- public API ----------------------------------------------------------
@@ -302,7 +330,7 @@ class HybridParallelEngine:
             local, mesh=mesh,
             in_specs=(flat_specs_tree, data_spec, data_spec),
             out_specs=(P(), flat_specs_tree),
-            check_vma=False)
+            check_vma=True)
 
         lr = self.lr
 

