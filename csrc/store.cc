@@ -13,7 +13,14 @@
 //
 // Wire format: [u8 op][u32 klen][key][u64 vlen][value]
 //   ops: 0=SET 1=GET 2=ADD 3=WAIT(key exists) 4=PING
+//        5=BARRIER_ENTER(value=u64 world_size; payload=u64 assigned round)
+//        6=BARRIER_CHECK(value=u64 round; status 0 when that round completed)
 // Response: [i64 status/len][payload]   (status<0 = not found/timeout)
+//
+// Barriers are tracked server-side per prefix as (round, count,
+// last_completed): entering assigns the server's current round, so a rank
+// that restarts (elastic) simply joins the live round — no client-local
+// generation state to desynchronize — and state per prefix is O(1) forever.
 #include "export.h"
 
 #include <arpa/inet.h>
@@ -43,7 +50,7 @@ namespace {
 using Clock = std::chrono::steady_clock;
 
 enum Op : uint8_t { OP_SET = 0, OP_GET = 1, OP_ADD = 2, OP_WAIT = 3,
-                    OP_PING = 4 };
+                    OP_PING = 4, OP_BARRIER_ENTER = 5, OP_BARRIER_CHECK = 6 };
 
 bool send_all(int fd, const void* buf, size_t n) {
   const char* p = static_cast<const char*>(buf);
@@ -94,7 +101,7 @@ class StoreServer {
     running_ = false;
     if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR), ::close(listen_fd_);
     if (thread_.joinable()) thread_.join();
-    for (int fd : clients_) ::close(fd);
+    for (auto& c : conns_) ::close(c.fd);
   }
 
   ~StoreServer() { stop(); }
@@ -106,11 +113,19 @@ class StoreServer {
     return false;
   }
 
+  // Per-connection state: reads are non-blocking and buffered so one
+  // slow/partial client can never stall the reactor (the other ranks'
+  // GET/WAIT polls keep being served while a frame trickles in).
+  struct Conn {
+    int fd;
+    std::string inbuf;
+  };
+
   void loop() {
     while (running_) {
       std::vector<pollfd> fds;
       fds.push_back({listen_fd_, POLLIN, 0});
-      for (int fd : clients_) fds.push_back({fd, POLLIN, 0});
+      for (auto& c : conns_) fds.push_back({c.fd, POLLIN, 0});
       int rc = ::poll(fds.data(), fds.size(), 200);
       if (rc <= 0) continue;
       if (fds[0].revents & POLLIN) {
@@ -118,32 +133,63 @@ class StoreServer {
         if (c >= 0) {
           int yes = 1;
           ::setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &yes, sizeof(yes));
-          clients_.push_back(c);
+          // reads use MSG_DONTWAIT (never stall the reactor); writes stay
+          // blocking but bounded so a stuck reader fails after 5s instead
+          // of hanging every rank
+          timeval tv{5, 0};
+          ::setsockopt(c, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+          conns_.push_back({c, {}});
         }
       }
+      std::vector<int> dead;
       for (size_t i = 1; i < fds.size(); ++i) {
-        if (!(fds[i].revents & (POLLIN | POLLHUP))) continue;
-        if (!handle(fds[i].fd)) {
-          ::close(fds[i].fd);
-          clients_.erase(std::remove(clients_.begin(), clients_.end(),
-                                     fds[i].fd),
-                         clients_.end());
-        }
+        if (!(fds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        if (!drain(conns_[i - 1])) dead.push_back(fds[i].fd);
+      }
+      for (int fd : dead) {
+        ::close(fd);
+        conns_.erase(std::remove_if(conns_.begin(), conns_.end(),
+                                    [fd](const Conn& c) { return c.fd == fd; }),
+                     conns_.end());
       }
     }
   }
 
-  bool handle(int fd) {
-    uint8_t op;
-    uint32_t klen;
-    if (!recv_all(fd, &op, 1) || !recv_all(fd, &klen, 4)) return false;
-    std::string key(klen, '\0');
-    if (klen && !recv_all(fd, key.data(), klen)) return false;
-    uint64_t vlen;
-    if (!recv_all(fd, &vlen, 8)) return false;
-    std::string val(vlen, '\0');
-    if (vlen && !recv_all(fd, val.data(), vlen)) return false;
+  // Read whatever is available without blocking, then process every
+  // complete frame in the buffer. Returns false when the peer is gone.
+  bool drain(Conn& conn) {
+    char chunk[65536];
+    while (true) {
+      ssize_t r = ::recv(conn.fd, chunk, sizeof(chunk), MSG_DONTWAIT);
+      if (r > 0) {
+        conn.inbuf.append(chunk, r);
+        if (static_cast<size_t>(r) < sizeof(chunk)) break;
+        continue;
+      }
+      if (r == 0) return false;  // orderly shutdown
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      return false;
+    }
+    while (true) {
+      const std::string& b = conn.inbuf;
+      if (b.size() < 5) return true;
+      uint32_t klen;
+      std::memcpy(&klen, b.data() + 1, 4);
+      if (b.size() < size_t{5} + klen + 8) return true;
+      uint64_t vlen;
+      std::memcpy(&vlen, b.data() + 5 + klen, 8);
+      size_t frame = size_t{5} + klen + 8 + vlen;
+      if (b.size() < frame) return true;
+      uint8_t op = static_cast<uint8_t>(b[0]);
+      std::string key = b.substr(5, klen);
+      std::string val = b.substr(5 + klen + 8, vlen);
+      conn.inbuf.erase(0, frame);
+      if (!respond(conn.fd, op, key, val)) return false;
+    }
+  }
 
+  bool respond(int fd, uint8_t op, const std::string& key,
+               const std::string& val) {
     int64_t status = 0;
     std::string payload;
     {
@@ -181,6 +227,31 @@ class StoreServer {
         case OP_WAIT:
           status = data_.count(key) ? 0 : -1;
           break;
+        case OP_BARRIER_ENTER: {
+          uint64_t world = 0;
+          std::memcpy(&world, val.data(), std::min<size_t>(8, val.size()));
+          Barrier& b = barriers_[key];
+          int64_t round = b.round;
+          if (++b.count >= static_cast<int64_t>(world) && world > 0) {
+            b.completed = b.round;
+            b.round += 1;
+            b.count = 0;
+          }
+          std::string enc(8, '\0');
+          std::memcpy(enc.data(), &round, 8);
+          payload = enc;
+          status = 8;
+          break;
+        }
+        case OP_BARRIER_CHECK: {
+          int64_t round = 0;
+          std::memcpy(&round, val.data(), std::min<size_t>(8, val.size()));
+          auto it = barriers_.find(key);
+          status = (it != barriers_.end() && it->second.completed >= round)
+                       ? 0
+                       : -1;
+          break;
+        }
         case OP_PING:
           status = 0;
           break;
@@ -198,9 +269,16 @@ class StoreServer {
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
   std::thread thread_;
-  std::vector<int> clients_;
+  struct Barrier {
+    int64_t round = 0;
+    int64_t count = 0;
+    int64_t completed = -1;
+  };
+
+  std::vector<Conn> conns_;
   std::mutex mu_;
   std::map<std::string, std::string> data_;
+  std::map<std::string, Barrier> barriers_;
 };
 
 // ---------------- client ----------------
@@ -354,21 +432,32 @@ PT_EXPORT int pt_store_wait(pt_store_t h, const char* key, int timeout_ms) {
 
 PT_EXPORT int pt_store_barrier(pt_store_t h, const char* prefix, int rank,
                                int world_size, int timeout_ms) {
-  // counter barrier (reference tcp_store.cc barrier): each rank ADDs 1,
-  // then waits for the counter to reach world_size
+  // server-tracked round barrier: ENTER joins the server's current round
+  // for this prefix, then polls until that round completes. Reusing a
+  // prefix starts a fresh round, and a restarted rank joins the live round
+  // (no client-local generation state).
   auto* s = static_cast<Store*>(h);
-  std::string key = std::string(prefix) + "/barrier";
-  int64_t v = pt_store_add(h, key.c_str(), 1);
-  if (v == INT64_MIN) return -1;
+  std::string world(8, '\0');
+  int64_t ws = world_size;
+  std::memcpy(world.data(), &ws, 8);
+  std::string out;
+  if (s->client->request(OP_BARRIER_ENTER, prefix, world, &out) != 8) {
+    pt::set_error("barrier enter failed");
+    return -1;
+  }
+  int64_t round;
+  std::memcpy(&round, out.data(), 8);
+  std::string rv(8, '\0');
+  std::memcpy(rv.data(), &round, 8);
   auto deadline = Clock::now() + std::chrono::milliseconds(timeout_ms);
-  while (v < world_size) {
-    if (Clock::now() >= deadline) {
-      pt::set_error("barrier timeout: " + std::to_string(v) + "/" +
-                    std::to_string(world_size));
+  while (true) {
+    int64_t st = s->client->request(OP_BARRIER_CHECK, prefix, rv, nullptr);
+    if (st == 0) return 0;
+    if (st == -3 || Clock::now() >= deadline) {
+      pt::set_error("barrier timeout (prefix " + std::string(prefix) +
+                    ", round " + std::to_string(round) + ")");
       return -1;
     }
     std::this_thread::sleep_for(std::chrono::milliseconds(50));
-    v = pt_store_add(h, key.c_str(), 0);
   }
-  return 0;
 }

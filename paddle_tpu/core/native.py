@@ -140,12 +140,17 @@ class TCPStore:
 
     def get(self, key, timeout=30.0):
         cap = 1 << 20
-        buf = ctypes.create_string_buffer(cap)
-        n = self._lib.pt_store_get(self._h, key.encode(), buf, cap,
-                                   int(timeout * 1000))
-        if n < 0:
-            raise RuntimeError(f"store get({key!r}) timed out")
-        return buf.raw[:n]
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.pt_store_get(self._h, key.encode(), buf, cap,
+                                       int(timeout * 1000))
+            if n < 0:
+                raise RuntimeError(f"store get({key!r}) timed out")
+            if n <= cap:
+                return buf.raw[:n]
+            # value longer than the buffer: pt_store_get returns the full
+            # length but copies at most cap bytes — retry at the real size
+            cap = n
 
     def add(self, key, delta):
         v = self._lib.pt_store_add(self._h, key.encode(), int(delta))

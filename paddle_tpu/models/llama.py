@@ -205,10 +205,10 @@ class LlamaAttention(nn.Layer):
             v = paddle.concat([past_key_value[1], v], axis=1)
         new_cache = (k, v) if use_cache else None
 
-        if self.num_kv_heads != self.num_heads:
-            rep = self.num_heads // self.num_kv_heads
-            k = paddle.repeat_interleave(k, rep, axis=2)
-            v = paddle.repeat_interleave(v, rep, axis=2)
+        # GQA (num_kv_heads < num_heads) passes through natively: the Pallas
+        # kernel maps query head h onto kv head h // group, and the XLA
+        # fallback repeats kv heads internally — kv is never materialized at
+        # full head count here, preserving the KV-cache memory win.
 
         # causal always holds; with a KV cache the offset diagonal
         # tril(k=sk-sq) lets the query chunk at positions [offset, offset+s)

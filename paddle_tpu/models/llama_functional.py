@@ -28,7 +28,6 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 
 class LlamaArgs(NamedTuple):
@@ -129,21 +128,14 @@ def apply_rope(q, k, cos, sin):
 
 
 def _attention(q, k, v, use_flash):
-    """q,k,v: [b, s, h, d], causal."""
-    if use_flash and jax.default_backend() == "tpu":
-        try:
-            from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+    """q: [b, s, h, d]; k/v: [b, s, hk, d] (GQA: hk may divide h), causal."""
+    from paddle_tpu.kernels import flash_attention as fa
+    from paddle_tpu.nn.functional.flash_attention import _sdpa_reference
 
-            return flash_attention_fwd(q, k, v, causal=True)
-        except Exception:
-            pass
-    d = q.shape[-1]
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (1.0 / np.sqrt(d))
-    s = logits.shape[-1]
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    logits = jnp.where(mask, logits, -1e30)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    if (use_flash and jax.default_backend() == "tpu"
+            and fa.supports(q.shape, k.shape, q.dtype.itemsize)):
+        return fa.flash_attention_fwd(q, k, v, causal=True)
+    return _sdpa_reference(q, k, v, causal=True)
 
 
 def decoder_layer(p, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
@@ -182,9 +174,6 @@ def decoder_layer(p, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
     v = (hin @ p["wv"]).reshape(b, s, nkv, hd)
     cos_t, sin_t = cos[:s], sin[:s]
     q, k = apply_rope(q, k, cos_t, sin_t)
-    if nkv != nh:
-        k = jnp.repeat(k, nh // nkv, axis=2)
-        v = jnp.repeat(v, nh // nkv, axis=2)
     attn = _attention(q, k, v, args.use_flash)
     attn = attn.reshape(b, s, nh * hd)
     h = h + reduce_out(attn @ p["wo"])

@@ -1,9 +1,13 @@
 """Flash attention (reference dispatch: `python/paddle/nn/functional/flash_attention.py:486-530`;
 reference kernel: `paddle/phi/kernels/gpu/flash_attn_kernel.cu`).
 
-TPU-native design: a Pallas splash-style kernel (`paddle_tpu/kernels/flash_attention.py`)
-when running on TPU, otherwise an XLA softmax(QK^T)V fallback that the compiler
-fuses. Layout is paddle's [batch, seqlen, nheads, headdim].
+TPU-native design: Pallas fwd+bwd kernels (`paddle_tpu/kernels/flash_attention.py`)
+when running on TPU with supported shapes, otherwise an XLA softmax(QK^T)V
+fallback that the compiler fuses. GQA (fewer kv heads than query heads) is
+native in the Pallas path; the fallback repeats kv heads. Attention dropout
+runs in the fallback path (the Pallas kernels are deterministic, so dropout>0
+in training routes to the fallback). Layout is paddle's
+[batch, seqlen, nheads, headdim].
 """
 
 import math
@@ -12,10 +16,16 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor, apply
+from paddle_tpu.framework import random as _rng
 
 
-def _sdpa_reference(q, k, v, causal=False, dropout=0.0, scale=None, mask=None):
-    # q/k/v: [B, L, H, D] -> compute in [B, H, L, D]
+def _sdpa_reference(q, k, v, causal=False, dropout=0.0, scale=None, mask=None,
+                    dropout_key=None):
+    # q: [B, L, H, D]; k/v: [B, Lk, Hk, D] -> compute in [B, H, L, D]
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
@@ -32,26 +42,42 @@ def _sdpa_reference(q, k, v, causal=False, dropout=0.0, scale=None, mask=None):
         ql, kl = logits.shape[-2], logits.shape[-1]
         causal_mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
         logits = jnp.where(causal_mask, logits, -1e30)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    if dropout > 0.0 and dropout_key is not None:
+        # attention-probability dropout (reference applies dropout to the
+        # softmax output before the value matmul, flash_attn_kernel.cu)
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+    probs = probs.astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2)
 
 
-def _use_pallas(q):
-    return jax.default_backend() == "tpu" and q.shape[1] % 128 == 0
+def _use_pallas(q, k, dropout=0.0, training=True, mask=None):
+    if jax.default_backend() != "tpu":
+        return False
+    if mask is not None:
+        return False
+    if dropout > 0.0 and training:
+        # the Pallas kernels are deterministic; dropout runs in the fallback
+        return False
+    from paddle_tpu.kernels import flash_attention as fa
+
+    return fa.supports(q.shape, k.shape, q.dtype.itemsize)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
                     fixed_seed_offset=None, rng_name="", training=True, name=None):
-    def fn(q, k, v):
-        if _use_pallas(q):
-            try:
-                from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+    drop = dropout if training else 0.0
+    dropout_key = _rng.next_key() if drop > 0.0 else None
 
-                return flash_attention_fwd(q, k, v, causal=causal)
-            except Exception:
-                pass
-        return _sdpa_reference(q, k, v, causal=causal)
+    def fn(q, k, v):
+        if _use_pallas(q, k, dropout=drop, training=training):
+            from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+
+            return flash_attention_fwd(q, k, v, causal=causal)
+        return _sdpa_reference(q, k, v, causal=causal, dropout=drop,
+                               dropout_key=dropout_key)
 
     out = apply(fn, query, key, value, _name="flash_attention")
     return out, None
@@ -64,16 +90,16 @@ def flash_attn_unpadded(*args, **kwargs):
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
                                  is_causal=False, training=True, name=None):
     m = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
+    drop = dropout_p if training else 0.0
+    dropout_key = _rng.next_key() if drop > 0.0 else None
 
     def fn(q, k, v):
-        if m is None and _use_pallas(q):
-            try:
-                from paddle_tpu.kernels.flash_attention import flash_attention_fwd
+        if _use_pallas(q, k, dropout=drop, training=training, mask=m):
+            from paddle_tpu.kernels.flash_attention import flash_attention_fwd
 
-                return flash_attention_fwd(q, k, v, causal=is_causal)
-            except Exception:
-                pass
-        return _sdpa_reference(q, k, v, causal=is_causal, mask=m)
+            return flash_attention_fwd(q, k, v, causal=is_causal)
+        return _sdpa_reference(q, k, v, causal=is_causal, mask=m, dropout=drop,
+                               dropout_key=dropout_key)
 
     return apply(fn, query, key, value, _name="sdpa")
 

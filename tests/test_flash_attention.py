@@ -1,4 +1,5 @@
-"""Pallas flash attention vs XLA reference (interpret mode on CPU)."""
+"""Pallas flash attention (fwd + bwd kernels) vs XLA reference (interpret
+mode on CPU)."""
 
 import jax
 import jax.numpy as jnp
@@ -6,48 +7,99 @@ import numpy as np
 import pytest
 
 from paddle_tpu.kernels.flash_attention import (
-    _flash_attention, _sdpa_xla, flash_attention_fwd)
+    _flash_attention, _sdpa_xla, flash_attention_fwd, supports)
 
 _INTERPRET = jax.default_backend() != "tpu"
+
+
+def _rand(rng, shape):
+    return jnp.asarray(rng.normal(size=shape), jnp.float32)
 
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_matches_reference(causal):
     rng = np.random.default_rng(0)
-    q = jnp.asarray(rng.normal(size=(2, 256, 4, 64)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(2, 256, 4, 64)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(2, 256, 4, 64)), jnp.float32)
+    q = _rand(rng, (2, 256, 4, 64))
+    k = _rand(rng, (2, 256, 4, 64))
+    v = _rand(rng, (2, 256, 4, 64))
     out = _flash_attention(q, k, v, causal, 0.125, _INTERPRET)
     ref = _sdpa_xla(q, k, v, causal, 0.125)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-2)
 
 
-def test_flash_grad_matches_reference():
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grad_matches_reference(causal):
+    """Backward runs the Pallas dq and dk/dv kernels — compare all three
+    grads against the XLA vjp."""
     rng = np.random.default_rng(1)
-    q = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
-    g1 = jax.grad(lambda q: _flash_attention(q, k, v, True, 0.125,
-                                             _INTERPRET).sum())(q)
-    g2 = jax.grad(lambda q: _sdpa_xla(q, k, v, True, 0.125).sum())(q)
-    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-2)
+    q = _rand(rng, (1, 256, 2, 64))
+    k = _rand(rng, (1, 256, 2, 64))
+    v = _rand(rng, (1, 256, 2, 64))
+
+    def loss_flash(q, k, v):
+        return (_flash_attention(q, k, v, causal, 0.125, _INTERPRET) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (_sdpa_xla(q, k, v, causal, 0.125) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2,
+                                   rtol=1e-3, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gqa_native(causal):
+    """num_kv_heads < num_heads without repeating kv (fwd + all grads)."""
+    rng = np.random.default_rng(5)
+    q = _rand(rng, (1, 256, 8, 32))
+    k = _rand(rng, (1, 256, 2, 32))
+    v = _rand(rng, (1, 256, 2, 32))
+    assert supports(q.shape, k.shape)
+    out = _flash_attention(q, k, v, causal, 0.125, _INTERPRET)
+    ref = _sdpa_xla(q, k, v, causal, 0.125)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-2)
+
+    g1 = jax.grad(lambda q, k, v: (_flash_attention(
+        q, k, v, causal, 0.125, _INTERPRET) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: (_sdpa_xla(
+        q, k, v, causal, 0.125) ** 2).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        assert a.shape == b.shape  # dk/dv stay at kv head count
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2,
+                                   rtol=1e-3, err_msg=f"d{name}")
 
 
 def test_cross_length_causal():
     """sq != sk uses the offset diagonal tril(k=sk-sq)."""
     rng = np.random.default_rng(3)
-    q = jnp.asarray(rng.normal(size=(1, 128, 2, 64)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(1, 256, 2, 64)), jnp.float32)
+    q = _rand(rng, (1, 128, 2, 64))
+    k = _rand(rng, (1, 256, 2, 64))
+    v = _rand(rng, (1, 256, 2, 64))
     out = _flash_attention(q, k, v, True, 0.125, _INTERPRET)
     ref = _sdpa_xla(q, k, v, True, 0.125)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-2)
 
 
+def test_cross_length_causal_grad():
+    rng = np.random.default_rng(6)
+    q = _rand(rng, (1, 128, 2, 64))
+    k = _rand(rng, (1, 256, 2, 64))
+    v = _rand(rng, (1, 256, 2, 64))
+    g1 = jax.grad(lambda q, k, v: _flash_attention(
+        q, k, v, True, 0.125, _INTERPRET).sum(), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: _sdpa_xla(
+        q, k, v, True, 0.125).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2,
+                                   rtol=1e-3, err_msg=f"d{name}")
+
+
 def test_seq_384_not_multiple_of_block():
     """seq % 128 == 0 but % 256 != 0 must shrink the block, not drop rows."""
     rng = np.random.default_rng(4)
-    q = jnp.asarray(rng.normal(size=(1, 384, 2, 64)), jnp.float32)
+    q = _rand(rng, (1, 384, 2, 64))
     out = _flash_attention(q, q, q, True, 0.125, _INTERPRET)
     ref = _sdpa_xla(q, q, q, True, 0.125)
     assert np.isfinite(np.asarray(out)).all()
@@ -56,6 +108,36 @@ def test_seq_384_not_multiple_of_block():
 
 def test_unaligned_seq_falls_back():
     rng = np.random.default_rng(2)
-    q = jnp.asarray(rng.normal(size=(1, 100, 2, 64)), jnp.float32)
+    q = _rand(rng, (1, 100, 2, 64))
     out = flash_attention_fwd(q, q, q, causal=True)
     assert out.shape == (1, 100, 2, 64)
+
+
+def test_supports_predicate():
+    assert supports((1, 256, 8, 64), (1, 256, 8, 64))
+    assert supports((1, 256, 8, 64), (1, 256, 2, 64))
+    assert not supports((1, 100, 8, 64), (1, 100, 8, 64))  # unaligned seq
+    assert not supports((1, 256, 6, 64), (1, 256, 4, 64))  # h % hk != 0
+
+
+def test_attention_dropout_applied():
+    """dropout>0 in training changes the output and zeroes ~p of the
+    attention mass; eval mode is deterministic (ADVICE: previously silently
+    ignored)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional.flash_attention import (
+        flash_attention, scaled_dot_product_attention)
+
+    paddle.seed(7)
+    rng = np.random.default_rng(7)
+    q = paddle.to_tensor(rng.normal(size=(1, 64, 2, 16)).astype("float32"))
+    out_det = flash_attention(q, q, q, dropout=0.5, training=False)[0]
+    out_det2 = flash_attention(q, q, q, dropout=0.5, training=False)[0]
+    np.testing.assert_array_equal(out_det.numpy(), out_det2.numpy())
+
+    out_drop = flash_attention(q, q, q, dropout=0.5, training=True)[0]
+    assert not np.allclose(out_drop.numpy(), out_det.numpy())
+
+    out_sdpa = scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                            training=True)
+    assert not np.allclose(out_sdpa.numpy(), out_det.numpy())

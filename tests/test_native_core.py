@@ -60,6 +60,46 @@ def test_tcp_store_barrier_across_clients():
     assert not errs
 
 
+def test_tcp_store_large_value():
+    """Values over the client's initial 1 MB buffer round-trip intact
+    (the get retries with the reported full length)."""
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True, world_size=1)
+    big = bytes(range(256)) * (8192 + 17)  # ~2.1 MB, patterned
+    master.set("big", big)
+    assert master.get("big") == big
+
+
+def test_tcp_store_barrier_prefix_reuse():
+    """Reusing a prefix must run a fresh barrier (generation-numbered keys),
+    not observe the previous barrier's counter."""
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    worker = native.TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+
+    def both(n):
+        errs = []
+
+        def rank1():
+            try:
+                worker.barrier("epoch", rank=1, world_size=2, timeout=5.0)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        th = threading.Thread(target=rank1)
+        th.start()
+        master.barrier("epoch", rank=0, world_size=2, timeout=5.0)
+        th.join()
+        assert not errs, errs
+
+    both(1)
+    both(2)  # same prefix again
+    # a second barrier with only one participant must time out, not return
+    # immediately off the stale counter
+    with pytest.raises(RuntimeError, match="barrier"):
+        master.barrier("epoch", rank=0, world_size=2, timeout=0.5)
+
+
 def test_tcp_store_barrier_timeout():
     port = _free_port()
     master = native.TCPStore("127.0.0.1", port, is_master=True, world_size=2)
