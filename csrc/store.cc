@@ -13,14 +13,16 @@
 //
 // Wire format: [u8 op][u32 klen][key][u64 vlen][value]
 //   ops: 0=SET 1=GET 2=ADD 3=WAIT(key exists) 4=PING
-//        5=BARRIER_ENTER(value=u64 world_size; payload=u64 assigned round)
+//        5=BARRIER_ENTER(value=u64 world_size,u64 rank; payload=u64 round)
 //        6=BARRIER_CHECK(value=u64 round; status 0 when that round completed)
 // Response: [i64 status/len][payload]   (status<0 = not found/timeout)
 //
-// Barriers are tracked server-side per prefix as (round, count,
+// Barriers are tracked server-side per prefix as (round, member-rank set,
 // last_completed): entering assigns the server's current round, so a rank
 // that restarts (elastic) simply joins the live round — no client-local
-// generation state to desynchronize — and state per prefix is O(1) forever.
+// generation state to desynchronize. Membership is a rank SET, not a
+// counter, so a rank retrying after a timeout re-enters idempotently
+// instead of double-counting and completing the round alone.
 #include "export.h"
 
 #include <arpa/inet.h>
@@ -37,6 +39,7 @@
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -228,14 +231,16 @@ class StoreServer {
           status = data_.count(key) ? 0 : -1;
           break;
         case OP_BARRIER_ENTER: {
-          uint64_t world = 0;
-          std::memcpy(&world, val.data(), std::min<size_t>(8, val.size()));
+          uint64_t world = 0, rank = 0;
+          if (val.size() >= 8) std::memcpy(&world, val.data(), 8);
+          if (val.size() >= 16) std::memcpy(&rank, val.data() + 8, 8);
           Barrier& b = barriers_[key];
           int64_t round = b.round;
-          if (++b.count >= static_cast<int64_t>(world) && world > 0) {
+          b.members.insert(static_cast<int64_t>(rank));
+          if (world > 0 && b.members.size() >= world) {
             b.completed = b.round;
             b.round += 1;
-            b.count = 0;
+            b.members.clear();
           }
           std::string enc(8, '\0');
           std::memcpy(enc.data(), &round, 8);
@@ -271,8 +276,8 @@ class StoreServer {
   std::thread thread_;
   struct Barrier {
     int64_t round = 0;
-    int64_t count = 0;
     int64_t completed = -1;
+    std::set<int64_t> members;
   };
 
   std::vector<Conn> conns_;
@@ -437,11 +442,12 @@ PT_EXPORT int pt_store_barrier(pt_store_t h, const char* prefix, int rank,
   // prefix starts a fresh round, and a restarted rank joins the live round
   // (no client-local generation state).
   auto* s = static_cast<Store*>(h);
-  std::string world(8, '\0');
-  int64_t ws = world_size;
-  std::memcpy(world.data(), &ws, 8);
+  std::string enter(16, '\0');
+  int64_t ws = world_size, rk = rank;
+  std::memcpy(enter.data(), &ws, 8);
+  std::memcpy(enter.data() + 8, &rk, 8);
   std::string out;
-  if (s->client->request(OP_BARRIER_ENTER, prefix, world, &out) != 8) {
+  if (s->client->request(OP_BARRIER_ENTER, prefix, enter, &out) != 8) {
     pt::set_error("barrier enter failed");
     return -1;
   }

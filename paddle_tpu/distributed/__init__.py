@@ -35,6 +35,7 @@ from paddle_tpu.distributed.communication import (  # noqa: F401
 from paddle_tpu.distributed.parallel import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv, DataParallel,
 )
+from paddle_tpu.distributed.engine import Engine  # noqa: F401
 
 
 import importlib as _importlib

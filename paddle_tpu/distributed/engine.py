@@ -1,0 +1,436 @@
+"""Model-generic compiled parallel engine.
+
+TPU-native counterpart of the reference auto-parallel `Engine`
+(`python/paddle/distributed/auto_parallel/static/engine.py:99`) and fleet's
+dygraph dispatch (`python/paddle/distributed/fleet/model.py:143-188`): takes
+ANY `nn.Layer` + loss + optimizer + strategy, functionalizes the layer
+(`paddle_tpu.jit.functionalize`) and builds ONE jitted XLA train step over a
+`jax.sharding.Mesh`:
+
+  - **DP**: the batch is sharded over the 'dp' mesh axis; GSPMD inserts the
+    gradient all-reduce (the reference's `EagerReducer` fused allreduce,
+    `reducer.cc:1089`) because parameters are replicated while data is not.
+  - **ZeRO-1/2 (sharding stage 1/2)**: optimizer moments are sharded over
+    'dp' along the first divisible axis (the optimizer-state partition of
+    `group_sharded_optimizer_stage2.py:53`); XLA lowers the grad+update to
+    reduce-scatter + sharded update + all-gather of the params.
+  - **ZeRO-3 (sharding stage 3)**: parameters themselves are sharded over
+    'dp' (`group_sharded_stage3.py:85`); XLA all-gathers each weight right
+    before use and frees it after, like the stage-3 pre-forward hooks.
+  - **TP**: an optional `mp_spec_fn(name, shape) -> PartitionSpec` annotates
+    weights over the 'mp' axis; XLA's SPMD partitioner propagates the
+    sharding and inserts the Megatron collectives (what the reference
+    hand-writes in `mp_ops.py:77-385`).
+
+Pipeline parallelism for the flagship model lives in the shard_map-based
+`HybridParallelEngine` (hybrid_engine.py); this Engine is the breadth path —
+ResNet DP, BERT ZeRO-2, any user Layer — compiled end-to-end.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["Engine"]
+
+
+# --------------------------------------------------------------------------
+# functional optimizers (mirror paddle_tpu.optimizer.* update rules)
+# --------------------------------------------------------------------------
+
+
+def _fn_sgd(hp):
+    def init(params):
+        return {"step": jnp.zeros((), jnp.int32)}
+
+    def update(p, g, s, lr):
+        g = g.astype(jnp.float32)
+        if hp["weight_decay"]:
+            g = g + hp["weight_decay"] * p.astype(jnp.float32)
+        return (p.astype(jnp.float32) - lr * g).astype(p.dtype), ()
+
+    return init, update, ()
+
+
+def _fn_momentum(hp):
+    def init(params):
+        return {"velocity": jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            "step": jnp.zeros((), jnp.int32)}
+
+    def update(p, g, s, lr):
+        (v,) = s
+        g = g.astype(jnp.float32)
+        if hp["weight_decay"]:
+            g = g + hp["weight_decay"] * p.astype(jnp.float32)
+        v = hp["momentum"] * v + g
+        step = hp["momentum"] * v + g if hp["nesterov"] else v
+        return (p.astype(jnp.float32) - lr * step).astype(p.dtype), (v,)
+
+    return init, update, ("velocity",)
+
+
+def _fn_adam(hp, decoupled_wd):
+    b1, b2, eps = hp["beta1"], hp["beta2"], hp["epsilon"]
+    wd = hp["weight_decay"]
+
+    def init(params):
+        z = lambda: jax.tree.map(  # noqa: E731
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return {"m": z(), "v": z(), "step": jnp.zeros((), jnp.int32)}
+
+    def update(p, g, s, lr, *, step):
+        m, v = s
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        if wd and not decoupled_wd:  # classic Adam L2: decay folded into grad
+            g32 = g32 + wd * p32
+        m = b1 * m + (1 - b1) * g32
+        v = b2 * v + (1 - b2) * (g32 * g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + eps)
+        if wd and decoupled_wd:  # AdamW
+            upd = upd + wd * p32
+        return (p32 - lr * upd).astype(p.dtype), (m, v)
+
+    return init, update, ("m", "v")
+
+
+def _functionalize_optimizer(opt):
+    """Map a paddle_tpu.optimizer.* instance to (init, update, slot_names).
+
+    The eager optimizers keep per-param `_acc` slots (optimizer.py:116); this
+    adapter re-expresses the same update rules as pure pytree functions for
+    the compiled step.
+    """
+    from paddle_tpu.optimizer import SGD, Adam, AdamW, Momentum
+
+    def hp(**kw):
+        return kw
+
+    if isinstance(opt, AdamW):
+        return _fn_adam(hp(beta1=opt._beta1, beta2=opt._beta2,
+                           epsilon=opt._epsilon,
+                           weight_decay=opt._wd or 0.0), True)
+    if isinstance(opt, Adam):
+        return _fn_adam(hp(beta1=opt._beta1, beta2=opt._beta2,
+                           epsilon=opt._epsilon,
+                           weight_decay=opt._weight_decay or 0.0), False)
+    if isinstance(opt, Momentum):
+        return _fn_momentum(hp(momentum=opt._momentum,
+                               weight_decay=opt._weight_decay or 0.0,
+                               nesterov=getattr(opt, "_nesterov", False)))
+    if isinstance(opt, SGD):
+        return _fn_sgd(hp(weight_decay=opt._weight_decay or 0.0))
+    raise TypeError(
+        f"Engine supports SGD/Momentum/Adam/AdamW, got {type(opt).__name__}")
+
+
+def _functional_grad_clip(clip):
+    """Pure-pytree version of Optimizer._apply_grad_clip (optimizer.py:86)."""
+    if clip is None:
+        return None
+    from paddle_tpu import nn
+
+    if isinstance(clip, nn.ClipGradByGlobalNorm):
+        def by_global_norm(grads):
+            total = jnp.sqrt(sum(
+                jnp.sum(jnp.square(g.astype(jnp.float32)))
+                for g in grads.values()))
+            coef = jnp.minimum(clip.clip_norm / jnp.maximum(total, 1e-6), 1.0)
+            return {k: (g * coef.astype(g.dtype)) for k, g in grads.items()}
+
+        return by_global_norm
+    if isinstance(clip, nn.ClipGradByNorm):
+        def by_norm(grads):
+            out = {}
+            for k, g in grads.items():
+                n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                coef = jnp.minimum(clip.clip_norm / jnp.maximum(n, 1e-6), 1.0)
+                out[k] = g * coef.astype(g.dtype)
+            return out
+
+        return by_norm
+    if isinstance(clip, nn.ClipGradByValue):
+        return lambda grads: {k: jnp.clip(g, clip.min, clip.max)
+                              for k, g in grads.items()}
+    raise TypeError(f"unsupported grad_clip for Engine: {type(clip).__name__}")
+
+
+# --------------------------------------------------------------------------
+# engine
+# --------------------------------------------------------------------------
+
+
+class Engine:
+    """Compile-and-run training/eval for any Layer over a device mesh.
+
+    Example (config-3 shape: BERT ZeRO-2)::
+
+        engine = Engine(model, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                        dp=8, sharding_stage=2)
+        loss = engine.train_batch([ids], [labels])
+    """
+
+    def __init__(self, model, loss=None, optimizer=None, strategy=None,
+                 dp=None, mp=1, sharding_stage=0, mesh=None, devices=None,
+                 mp_spec_fn=None, seed=0):
+        from paddle_tpu import jit as pjit
+
+        self.model = model
+        self.loss_layer = loss
+        self.optimizer = optimizer
+        if strategy is not None:  # fleet DistributedStrategy routing
+            h = strategy.hybrid_configs
+            if h.get("pp_degree", 1) not in (1, None):
+                raise ValueError(
+                    "Engine does not run pipeline parallelism; pp lives in "
+                    "HybridParallelEngine (hybrid_engine.py). Set pp_degree=1 "
+                    "or use the hybrid engine for the pipelined model.")
+            if dp is None and h["dp_degree"] not in (-1, None):
+                dp = h["dp_degree"]
+            mp = h["mp_degree"] or 1
+            if getattr(strategy, "sharding", False):
+                sharding_stage = strategy.sharding_configs.get("stage", 1) or 1
+        self.sharding_stage = sharding_stage
+
+        if mesh is not None:
+            self.mesh = mesh
+        else:
+            devices = devices if devices is not None else jax.devices()
+            dp = dp or (len(devices) // mp)
+            if dp * mp > len(devices):
+                raise ValueError(f"need {dp * mp} devices, have {len(devices)}")
+            self.mesh = Mesh(
+                np.asarray(devices[: dp * mp]).reshape(dp, mp), ("dp", "mp"))
+        self.dp = self.mesh.shape["dp"]
+        self.mp = self.mesh.shape.get("mp", 1)
+        self.mp_spec_fn = mp_spec_fn
+
+        self._pure_fn, self._params, self._buffers = pjit.functionalize(model)
+        self._key = jax.random.key(seed)
+        if optimizer is not None:
+            self._opt_init, self._opt_update, self._slots = \
+                _functionalize_optimizer(optimizer)
+            self._grad_clip = _functional_grad_clip(optimizer._grad_clip)
+        self._train_step = None
+        self._eval_step = None
+        self._state = None  # (params, opt_state, buffers) once placed
+
+    # -- sharding rules ------------------------------------------------------
+    def _param_spec(self, name, shape):
+        if self.mp_spec_fn is not None:
+            spec = self.mp_spec_fn(name, shape)
+            if spec is not None:
+                return spec
+        if self.sharding_stage >= 3:
+            return self._dp_shard_spec(shape)
+        return P(*([None] * len(shape)))
+
+    def _dp_shard_spec(self, shape, base=None):
+        """Shard over 'dp' along the first free axis it divides
+        (group_sharded_optimizer_stage2.py:53 partitions by numel; on TPU a
+        dimension split keeps XLA layouts intact)."""
+        parts = list(base) if base is not None else [None] * len(shape)
+        parts += [None] * (len(shape) - len(parts))  # P() is rank-agnostic
+        if "dp" in parts:  # already dp-sharded (e.g. stage-3 param spec)
+            return P(*parts)
+        for i, d in enumerate(shape):
+            if parts[i] is None and d % self.dp == 0 and d > 0:
+                parts[i] = "dp"
+                return P(*parts)
+        return P(*parts)
+
+    def _slot_spec(self, pspec, shape):
+        if self.sharding_stage >= 1 and self.dp > 1:
+            return self._dp_shard_spec(shape, base=pspec)
+        return pspec
+
+    def _sharding(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def _build_specs(self):
+        pspecs = {k: self._param_spec(k, v.shape)
+                  for k, v in self._params.items()}
+        sspecs = {k: self._slot_spec(pspecs[k], v.shape)
+                  for k, v in self._params.items()}
+        bspecs = {k: P(*([None] * v.ndim)) for k, v in self._buffers.items()}
+        return pspecs, sspecs, bspecs
+
+    # -- state ---------------------------------------------------------------
+    def _ensure_state(self):
+        if self._state is not None:
+            return
+        pspecs, sspecs, bspecs = self._build_specs()
+        self._pshard = {k: self._sharding(s) for k, s in pspecs.items()}
+        self._bshard = {k: self._sharding(s) for k, s in bspecs.items()}
+        params = {k: jax.device_put(v, self._pshard[k])
+                  for k, v in self._params.items()}
+        buffers = {k: jax.device_put(v, self._bshard[k])
+                   for k, v in self._buffers.items()}
+        opt_state = None
+        if self.optimizer is not None:
+            opt_state = self._opt_init(params)
+            self._oshard = {
+                name: {k: self._sharding(sspecs[k]) for k in params}
+                for name in self._slots}
+            self._oshard["step"] = self._sharding(P())
+            opt_state = {
+                name: ({k: jax.device_put(opt_state[name][k],
+                                          self._oshard[name][k])
+                        for k in params} if name != "step"
+                       else jax.device_put(opt_state["step"],
+                                           self._oshard["step"]))
+                for name in list(self._slots) + ["step"]}
+        self._state = [params, opt_state, buffers]
+
+    @property
+    def state(self):
+        self._ensure_state()
+        return self._state
+
+    # -- steps ---------------------------------------------------------------
+    def _loss_of(self, out, labels):
+        from paddle_tpu.core.tensor import Tensor
+
+        if self.loss_layer is None:
+            return out if not isinstance(out, Tensor) else out._data
+        t_out = jax.tree.map(
+            lambda a: Tensor(a) if isinstance(a, jax.Array) else a, out)
+        t_lab = [Tensor(l) for l in labels]
+        loss = self.loss_layer(t_out, *t_lab)
+        return loss._data if isinstance(loss, Tensor) else loss
+
+    def _build_train_step(self):
+        if self._train_step is not None:
+            return self._train_step
+        self._ensure_state()
+        opt_update, slots = self._opt_update, self._slots
+        grad_clip = self._grad_clip
+
+        def loss_fn(params, buffers, key, inputs, labels):
+            out, new_buf = self._pure_fn(params, buffers, key, *inputs)
+            return self._loss_of(out, labels), new_buf
+
+        def train_step(params, opt_state, buffers, key, lr, inputs, labels):
+            (loss, new_buf), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, buffers, key, inputs, labels)
+            if grad_clip is not None:
+                grads = grad_clip(grads)
+            step = opt_state["step"] + 1
+            new_params, new_slots = {}, {name: {} for name in slots}
+            for k, p in params.items():
+                s = tuple(opt_state[name][k] for name in slots)
+                kw = {"step": step} if "m" in slots else {}
+                np_, ns = opt_update(p, grads[k], s, lr, **kw)
+                new_params[k] = np_
+                for name, val in zip(slots, ns):
+                    new_slots[name][k] = val
+            new_opt = dict(new_slots)
+            new_opt["step"] = step
+            return loss, new_params, new_opt, new_buf
+
+        out_opt_shard = getattr(self, "_oshard", None)
+        self._train_step = jax.jit(
+            train_step,
+            donate_argnums=(0, 1, 2),
+            out_shardings=(None, self._pshard, out_opt_shard, self._bshard),
+        )
+        return self._train_step
+
+    def _place_batch(self, arrays):
+        """Host arrays -> device arrays with the leading dim sharded on 'dp'."""
+        out = []
+        for a in arrays:
+            a = np.asarray(a.numpy() if hasattr(a, "numpy") else a)
+            if a.shape[0] % self.dp != 0:
+                raise ValueError(
+                    f"global batch {a.shape[0]} must divide dp={self.dp}")
+            spec = P(*(["dp"] + [None] * (a.ndim - 1)))
+            out.append(jax.device_put(a, self._sharding(spec)))
+        return out
+
+    def train_batch(self, inputs, labels):
+        """One compiled optimizer step on a global batch; returns the loss."""
+        if self.optimizer is None:
+            raise RuntimeError("Engine built without an optimizer")
+        step = self._build_train_step()
+        params, opt_state, buffers = self._state
+        self._key, sub = jax.random.split(self._key)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        inputs = self._place_batch(inputs)
+        labels = self._place_batch(labels)
+        loss, params, opt_state, buffers = step(
+            params, opt_state, buffers, sub, lr, inputs, labels)
+        self._state = [params, opt_state, buffers]
+        if hasattr(self.optimizer, "_learning_rate") and hasattr(
+                self.optimizer._learning_rate, "step"):
+            self.optimizer._learning_rate.step()
+        return loss
+
+    def _build_eval_step(self):
+        if self._eval_step is not None:
+            return self._eval_step
+
+        def eval_step(params, buffers, key, inputs, labels):
+            out, _ = self._pure_fn(params, buffers, key, *inputs)
+            return self._loss_of(out, labels)
+
+        self._eval_step = jax.jit(eval_step)
+        return self._eval_step
+
+    def eval_batch(self, inputs, labels):
+        self._ensure_state()
+        params, _, buffers = self._state
+        step = self._build_eval_step()
+        self.model.eval()
+        try:
+            inputs = self._place_batch(inputs)
+            labels = self._place_batch(labels)
+            return step(params, buffers, self._key, inputs, labels)
+        finally:
+            self.model.train()
+
+    def predict_batch(self, inputs):
+        self._ensure_state()
+        params, _, buffers = self._state
+        if not hasattr(self, "_predict_step"):
+            self._predict_step = jax.jit(
+                lambda p, b, k, i: self._pure_fn(p, b, k, *i)[0])
+        self.model.eval()
+        try:
+            return self._predict_step(params, buffers, self._key,
+                                      self._place_batch(inputs))
+        finally:
+            self.model.train()
+
+    # -- hapi-style loop -----------------------------------------------------
+    def fit(self, loader, epochs=1, log_every=0):
+        """loader yields (inputs..., label) batches (paddle.io.DataLoader)."""
+        losses = []
+        for _ in range(epochs):
+            for batch in loader:
+                *inputs, label = batch
+                loss = self.train_batch(inputs, [label])
+                losses.append(float(jax.device_get(loss)))
+                if log_every and len(losses) % log_every == 0:
+                    print(f"step {len(losses)}: loss {losses[-1]:.4f}")
+        return losses
+
+    def sync_to_model(self):
+        """Write the engine's (possibly sharded) params/buffers back into the
+        eager Layer, gathered to host — e.g. before paddle.save."""
+        self._ensure_state()
+        params, _, buffers = self._state
+        for k, p in self.model.named_parameters():
+            p._data = jnp.asarray(jax.device_get(params[k]))
+        for k, b in self.model.named_buffers():
+            if k in buffers:
+                b._data = jnp.asarray(jax.device_get(buffers[k]))

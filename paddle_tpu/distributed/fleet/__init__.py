@@ -25,6 +25,7 @@ from paddle_tpu.distributed.fleet.recompute import recompute  # noqa: F401
 # module-level singleton dispatch (reference fleet/__init__.py)
 init = _fleet_singleton.init
 distributed_model = _fleet_singleton.distributed_model
+distributed_engine = _fleet_singleton.distributed_engine
 distributed_optimizer = _fleet_singleton.distributed_optimizer
 worker_index = _fleet_singleton.worker_index
 worker_num = _fleet_singleton.worker_num

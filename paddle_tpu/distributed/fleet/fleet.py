@@ -111,6 +111,18 @@ class Fleet:
             return mp.TensorParallel(model, self._hcg, self._strategy)
         return model
 
+    def distributed_engine(self, model, loss=None, optimizer=None, **kwargs):
+        """The compiled path behind distributed_model: build the generic
+        one-jit `Engine` (reference auto-parallel `Engine`, engine.py:99)
+        from this fleet's strategy — dp/sharding degrees become mesh axes
+        and ZeRO sharding rules."""
+        if self._hcg is None:
+            raise RuntimeError("call fleet.init() first")
+        from paddle_tpu.distributed.engine import Engine
+
+        return Engine(model, loss=loss, optimizer=optimizer,
+                      strategy=self._strategy, **kwargs)
+
     def distributed_optimizer(self, optimizer, strategy=None):
         """Reference fleet.py:1448 -> HybridParallelOptimizer."""
         if strategy is not None:
