@@ -84,20 +84,21 @@ def _fn_adam(hp, decoupled_wd):
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
         return {"m": z(), "v": z(), "step": jnp.zeros((), jnp.int32)}
 
-    def update(p, g, s, lr, *, step):
+    def update(p, g, s, lr, *, step, decay=True):
         m, v = s
         g32 = g.astype(jnp.float32)
         p32 = p.astype(jnp.float32)
-        if wd and not decoupled_wd:  # classic Adam L2: decay folded into grad
-            g32 = g32 + wd * p32
+        wd_p = wd if decay else 0.0
+        if wd_p and not decoupled_wd:  # classic Adam L2: decay in the grad
+            g32 = g32 + wd_p * p32
         m = b1 * m + (1 - b1) * g32
         v = b2 * v + (1 - b2) * (g32 * g32)
         t = step.astype(jnp.float32)
         mhat = m / (1 - b1 ** t)
         vhat = v / (1 - b2 ** t)
         upd = mhat / (jnp.sqrt(vhat) + eps)
-        if wd and decoupled_wd:  # AdamW
-            upd = upd + wd * p32
+        if wd_p and decoupled_wd:  # AdamW
+            upd = upd + wd_p * p32
         return (p32 - lr * upd).astype(p.dtype), (m, v)
 
     return init, update, ("m", "v")
@@ -115,7 +116,15 @@ def _functionalize_optimizer(opt):
     def hp(**kw):
         return kw
 
+    if isinstance(opt, (Adam, AdamW)) and getattr(opt, "_multi_precision",
+                                                  False):
+        raise NotImplementedError(
+            "Engine keeps moments in fp32 already; multi_precision master "
+            "weights are not supported in the compiled step")
     if isinstance(opt, AdamW):
+        if opt._lr_ratio is not None:
+            raise NotImplementedError(
+                "AdamW lr_ratio is not supported in the compiled Engine step")
         return _fn_adam(hp(beta1=opt._beta1, beta2=opt._beta2,
                            epsilon=opt._epsilon,
                            weight_decay=opt._wd or 0.0), True)
@@ -133,34 +142,46 @@ def _functionalize_optimizer(opt):
         f"Engine supports SGD/Momentum/Adam/AdamW, got {type(opt).__name__}")
 
 
-def _functional_grad_clip(clip):
-    """Pure-pytree version of Optimizer._apply_grad_clip (optimizer.py:86)."""
+def _functional_grad_clip(clip, clipable):
+    """Pure-pytree version of Optimizer._apply_grad_clip (optimizer.py:86).
+    `clipable` maps param name -> need_clip (params with need_clip=False are
+    excluded, matching the eager path)."""
     if clip is None:
         return None
     from paddle_tpu import nn
 
+    def keep(k):
+        return clipable.get(k, True)
+
     if isinstance(clip, nn.ClipGradByGlobalNorm):
         def by_global_norm(grads):
-            total = jnp.sqrt(sum(
-                jnp.sum(jnp.square(g.astype(jnp.float32)))
-                for g in grads.values()))
+            parts = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for k, g in grads.items() if keep(k)]
+            if not parts:
+                return grads
+            total = jnp.sqrt(sum(parts))
             coef = jnp.minimum(clip.clip_norm / jnp.maximum(total, 1e-6), 1.0)
-            return {k: (g * coef.astype(g.dtype)) for k, g in grads.items()}
+            return {k: (g * coef.astype(g.dtype)) if keep(k) else g
+                    for k, g in grads.items()}
 
         return by_global_norm
     if isinstance(clip, nn.ClipGradByNorm):
         def by_norm(grads):
             out = {}
             for k, g in grads.items():
-                n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
-                coef = jnp.minimum(clip.clip_norm / jnp.maximum(n, 1e-6), 1.0)
-                out[k] = g * coef.astype(g.dtype)
+                if keep(k):
+                    n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+                    coef = jnp.minimum(
+                        clip.clip_norm / jnp.maximum(n, 1e-6), 1.0)
+                    g = g * coef.astype(g.dtype)
+                out[k] = g
             return out
 
         return by_norm
     if isinstance(clip, nn.ClipGradByValue):
-        return lambda grads: {k: jnp.clip(g, clip.min, clip.max)
-                              for k, g in grads.items()}
+        return lambda grads: {
+            k: jnp.clip(g, clip.min, clip.max) if keep(k) else g
+            for k, g in grads.items()}
     raise TypeError(f"unsupported grad_clip for Engine: {type(clip).__name__}")
 
 
@@ -219,7 +240,16 @@ class Engine:
         if optimizer is not None:
             self._opt_init, self._opt_update, self._slots = \
                 _functionalize_optimizer(optimizer)
-            self._grad_clip = _functional_grad_clip(optimizer._grad_clip)
+            named = dict(model.named_parameters())
+            clipable = {k: getattr(p, "need_clip", True)
+                        for k, p in named.items()}
+            self._grad_clip = _functional_grad_clip(optimizer._grad_clip,
+                                                    clipable)
+            # AdamW apply_decay_param_fun: per-param decay mask by p.name
+            fn = getattr(optimizer, "_apply_decay_param_fun", None)
+            self._decay_mask = {
+                k: (fn(p.name) if fn is not None else True)
+                for k, p in named.items()}
         self._train_step = None
         self._eval_step = None
         self._state = None  # (params, opt_state, buffers) once placed
@@ -328,7 +358,8 @@ class Engine:
             new_params, new_slots = {}, {name: {} for name in slots}
             for k, p in params.items():
                 s = tuple(opt_state[name][k] for name in slots)
-                kw = {"step": step} if "m" in slots else {}
+                kw = ({"step": step, "decay": self._decay_mask.get(k, True)}
+                      if "m" in slots else {})
                 np_, ns = opt_update(p, grads[k], s, lr, **kw)
                 new_params[k] = np_
                 for name, val in zip(slots, ns):

@@ -16,7 +16,7 @@ import copy
 __all__ = ["DistributedStrategy"]
 
 _HYBRID_DEFAULTS = {
-    "dp_degree": 1,
+    "dp_degree": -1,  # -1: infer from the device count (reference default)
     "mp_degree": 1,
     "pp_degree": 1,
     "sharding_degree": 1,

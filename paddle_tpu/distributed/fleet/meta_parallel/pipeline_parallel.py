@@ -128,8 +128,41 @@ class PipelineParallel:
 
 
 class PipelineParallelWithInterleave(PipelineParallel):
-    """Interleaved (virtual) pipeline, reference :1308 — same math under the
-    single controller; kept as a named mode for schedule selection in the
-    compiled path."""
+    """Interleaved (virtual) pipeline, reference :1308.
 
-    pass
+    Under the single controller the loss/grad math is identical to 1F1B
+    (gradient accumulation), but this class carries the interleave *config* —
+    virtual stage count, chunk segmentation, and the schedule tag the
+    compiled path consumes (`HybridParallelEngine(schedule="interleave")`,
+    hybrid_engine.py `_pipeline_loss_vpp`). It validates the same invariants
+    the reference enforces (accumulate_steps % num_stages, chunk count
+    dividing the layer segments). The loss/grad math itself is inherited
+    micro-batch accumulation — chunk interleaving is realized on the mesh by
+    the compiled schedule, not re-enacted per-op here.
+    """
+
+    schedule = "interleave"
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        self.num_model_chunks = getattr(
+            layers, "_num_virtual_pipeline_stages", None) or \
+            strategy.pipeline_configs.get("vpp_degree", 2)
+        if self.num_model_chunks < 2:
+            raise ValueError(
+                "interleaved pipeline needs >= 2 virtual stages per rank "
+                "(reference pipeline_parallel.py:1322)")
+        if self.accumulate_steps % max(self.num_stages, 1) != 0:
+            raise ValueError(
+                "accumulate_steps must be divisible by the pipeline degree "
+                "for the interleaved schedule (reference :1330)")
+        segments = getattr(layers, "_segments", None)
+        if segments is not None and len(segments) % self.num_model_chunks:
+            raise ValueError(
+                f"number of layer segments ({len(segments)}) must be a "
+                f"multiple of num_model_chunks ({self.num_model_chunks})")
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        # same accumulation math; chunk interleaving is a per-rank execution
+        # order concern that the compiled schedule realizes on the mesh
+        return super().forward_backward_pipeline(data, scaler)

@@ -92,7 +92,8 @@ class HybridParallelEngine:
     """
 
     def __init__(self, config, dp=1, pp=1, mp=1, micro_batches=None, sp=False,
-                 devices=None, dtype=jnp.float32, remat=True, lr=3e-4):
+                 devices=None, dtype=jnp.float32, remat=True, lr=3e-4,
+                 schedule="gpipe", num_virtual_stages=2):
         from paddle_tpu.models.llama import LlamaConfig  # noqa: F401 (type)
 
         self.config = config
@@ -103,6 +104,23 @@ class HybridParallelEngine:
         self.dtype = dtype
         self.remat = remat
         self.lr = lr
+        if schedule not in ("gpipe", "1f1b", "interleave"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r} "
+                             "(gpipe | 1f1b | interleave)")
+        self.schedule = schedule if pp > 1 else "gpipe"
+        self.num_virtual_stages = num_virtual_stages
+        if self.schedule == "interleave":
+            V = num_virtual_stages
+            if V < 2:
+                raise ValueError("interleave needs num_virtual_stages >= 2")
+            if config.num_hidden_layers % (pp * V) != 0:
+                raise ValueError("num_hidden_layers must divide pp * "
+                                 "num_virtual_stages")
+            if self.micro_batches > pp:
+                # the synchronous chunked ring processes one unit per stage
+                # per tick; M <= S keeps the schedule collision-free (VPP's
+                # bubble win targets exactly this small-M regime)
+                raise ValueError("interleave requires micro_batches <= pp")
 
         if config.num_hidden_layers % max(pp, 1) != 0:
             raise ValueError("num_hidden_layers must divide pp")
@@ -184,14 +202,35 @@ class HybridParallelEngine:
             "step": self._sharding(P()),
         }
 
+    def _vpp_perm(self):
+        """Leading-dim permutation of the stacked layers for the interleaved
+        schedule: stage s's pp-shard holds its V chunks contiguously
+        ([chunk v=0..V-1], each L/(S·V) layers), chunk v being global virtual
+        stage v*S + s (reference pp_layers.py:264 chunked segmentation)."""
+        L, S, V = self.config.num_hidden_layers, self.pp, self.num_virtual_stages
+        lc = L // (S * V)
+        perm = [
+            (v * S + s) * lc + k
+            for s in range(S) for v in range(V) for k in range(lc)
+        ]
+        return np.asarray(perm)
+
     # -- init ---------------------------------------------------------------
     def init_state(self, seed=0):
         """Sharded params + ZeRO-sharded AdamW state, initialised on-device."""
         self._ensure_shardings()
         key = jax.random.key(seed)
         args, dtype = self.args, self.dtype
-        init_fn = jax.jit(lambda k: lf.init_params(args, k, dtype),
-                          out_shardings=self._param_shardings)
+        if self.schedule == "interleave":
+            perm = jnp.asarray(self._vpp_perm())
+
+            def make(k):
+                p = lf.init_params(args, k, dtype)
+                p["layers"] = jax.tree.map(lambda a: a[perm], p["layers"])
+                return p
+        else:
+            make = lambda k: lf.init_params(args, k, dtype)  # noqa: E731
+        init_fn = jax.jit(make, out_shardings=self._param_shardings)
         params = init_fn(key)
         opt_init = jax.jit(adamw_init, out_shardings=self._opt_shardings)
         opt_state = opt_init(params)
@@ -206,6 +245,40 @@ class HybridParallelEngine:
         return tdef.unflatten(flat_specs)
 
     # -- the pipelined local step (runs inside shard_map) --------------------
+    def _mk_stage_helpers(self, ids, labels, s_len):
+        """The per-stage pieces every schedule shares, parameterized on the
+        (pvary'd) param tree: embed a micro-batch, run the head+loss, and
+        build a vma-typed zero loss for non-owning stages."""
+        args = self.args
+        mp_axis = "mp" if self.mp > 1 else None
+        mp, sp = self.mp, self.sp
+
+        def embed_mb(lp, idx):
+            idm = jax.lax.dynamic_index_in_dim(ids, idx, 0, keepdims=False)
+            h = lf.embed_lookup(lp["embedding"], idm, args, mp_axis, mp)
+            h = h.astype(self.dtype)
+            if sp and mp_axis:
+                loc = s_len // mp
+                r = jax.lax.axis_index(mp_axis)
+                h = jax.lax.dynamic_slice_in_dim(h, r * loc, loc, axis=1)
+            return h
+
+        def head_loss(lp, h, idx):
+            h = lf.rms_norm(h, lp["final_norm"], args.rms_eps)
+            if sp and mp_axis:
+                h = jax.lax.all_gather(h, mp_axis, axis=1, tiled=True)
+            logits = h @ lp["lm_head"]
+            labm = jax.lax.dynamic_index_in_dim(labels, idx, 0, keepdims=False)
+            return lf.parallel_cross_entropy(logits, labm, args, mp_axis, mp)
+
+        def zero_loss(ref):
+            z = jnp.sum(ref).astype(jnp.float32) * 0
+            if sp and mp_axis:
+                z = jax.lax.psum(z, mp_axis)
+            return z
+
+        return embed_mb, head_loss, zero_loss
+
     def _pipeline_loss(self, lp, ids, labels):
         """Per-device GPipe loss. ids/labels local: [M, mb_local, s]."""
         args, S, M = self.args, self.pp, self.micro_batches
@@ -225,27 +298,12 @@ class HybridParallelEngine:
         for k in ("embedding", "lm_head", "final_norm"):
             lp[k] = jax.lax.pcast(lp[k], ("pp",), to="varying")
 
+        embed_mb, head_loss, zero_loss = self._mk_stage_helpers(
+            ids, labels, s_len)
+
         def stage_fn(h):
             return lf.run_layers(lp["layers"], h, cos, sin, args, mp_axis, mp,
                                  sp, self.remat)
-
-        def embed_mb(idx):
-            idm = jax.lax.dynamic_index_in_dim(ids, idx, 0, keepdims=False)
-            h = lf.embed_lookup(lp["embedding"], idm, args, mp_axis, mp)
-            h = h.astype(self.dtype)
-            if sp and mp_axis:
-                loc = s_len // mp
-                r = jax.lax.axis_index(mp_axis)
-                h = jax.lax.dynamic_slice_in_dim(h, r * loc, loc, axis=1)
-            return h
-
-        def head_loss(h, idx):
-            h = lf.rms_norm(h, lp["final_norm"], args.rms_eps)
-            if sp and mp_axis:
-                h = jax.lax.all_gather(h, mp_axis, axis=1, tiled=True)
-            logits = h @ lp["lm_head"]
-            labm = jax.lax.dynamic_index_in_dim(labels, idx, 0, keepdims=False)
-            return lf.parallel_cross_entropy(logits, labm, args, mp_axis, mp)
 
         perm = [(i, i + 1) for i in range(S - 1)]
 
@@ -264,21 +322,14 @@ class HybridParallelEngine:
             # zero-scaled adds tie the branch outputs to h_recv/h_out's vma
             # type without introducing a collective in forward or vjp.
             h_in = jax.lax.cond(stage == 0,
-                                lambda op: embed_mb(op[1]) + op[0] * 0,
+                                lambda op: embed_mb(lp, op[1]) + op[0] * 0,
                                 lambda op: op[0], (h_recv, in_idx))
             h_out = stage_fn(h_in)
             out_idx = t - (S - 1)
-
-            def zero_loss(op):
-                z = jnp.sum(op[0]).astype(jnp.float32) * 0
-                if sp and mp_axis:
-                    z = jax.lax.psum(z, mp_axis)
-                return z
-
             contrib = jax.lax.cond(
                 (stage == S - 1) & (out_idx >= 0),
-                lambda op: head_loss(op[0], jnp.clip(op[1], 0, M - 1)),
-                zero_loss, (h_out, out_idx))
+                lambda op: head_loss(lp, op[0], jnp.clip(op[1], 0, M - 1)),
+                lambda op: zero_loss(op[0]), (h_out, out_idx))
             return h_out, contrib
 
         mb_local = ids.shape[1]
@@ -302,6 +353,223 @@ class HybridParallelEngine:
         total = jax.lax.psum(total, "pp")
         return total
 
+    # -- interleaved / virtual pipeline (reference
+    #    pipeline_parallel.py:1308 PipelineParallelWithInterleave) ----------
+    def _pipeline_loss_vpp(self, lp, ids, labels):
+        """Chunked-ring interleaved schedule: the model is S·V virtual
+        stages; each physical stage hosts V chunks and micro-batches ride a
+        RING ppermute V times around the mesh. Each tick moves every
+        micro-batch one virtual stage (1/V of a stage's layers), so the
+        pipeline fill costs (S·V-1) chunk-times ≈ (S-1)/V stage-times —
+        the V-fold bubble reduction that is VPP's point. Requires M <= S
+        (collision-free synchronous ring). Backward is AD over the scan,
+        GPipe-memory like the reference's interleaved mode."""
+        args, S, M, V = self.args, self.pp, self.micro_batches, \
+            self.num_virtual_stages
+        mp_axis = "mp" if self.mp > 1 else None
+        mp, sp = self.mp, self.sp
+        stage = jax.lax.axis_index("pp")
+        s_len = ids.shape[-1]
+        hd = args.hidden_size // args.num_heads
+        cos, sin = lf.rope_tables(s_len, hd, args.rope_theta)
+        lc = args.num_layers // (S * V)  # layers per chunk
+
+        lp = dict(lp)
+        for k in ("embedding", "lm_head", "final_norm"):
+            lp[k] = jax.lax.pcast(lp[k], ("pp",), to="varying")
+
+        def chunk_fn(v_idx, h):
+            chunk = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, v_idx * lc, lc, 0),
+                lp["layers"])
+            return lf.run_layers(chunk, h, cos, sin, args, mp_axis, mp, sp,
+                                 self.remat)
+
+        embed_mb, head_loss, zero_loss = self._mk_stage_helpers(
+            ids, labels, s_len)
+        ring = [(i, (i + 1) % S) for i in range(S)]
+
+        def step(carry, t):
+            h_prev = carry
+            h_recv = jax.lax.ppermute(h_prev, "pp", ring) if S > 1 else h_prev
+            a = t - stage
+            f = jnp.mod(a, S)
+            v = a // S
+            valid = (a >= 0) & (f < M) & (v < V)
+            f_idx = jnp.clip(f, 0, M - 1)
+            v_idx = jnp.clip(v, 0, V - 1)
+            h_in = jax.lax.cond(
+                (stage == 0) & (v_idx == 0) & (a >= 0),
+                lambda op: embed_mb(lp, op[1]) + op[0] * 0,
+                lambda op: op[0], (h_recv, f_idx))
+            h_out = chunk_fn(v_idx, h_in)
+            contrib = jax.lax.cond(
+                (stage == S - 1) & (v_idx == V - 1) & valid,
+                lambda op: head_loss(lp, op[0], op[1]),
+                lambda op: zero_loss(op[0]), (h_out, f_idx))
+            return h_out, contrib
+
+        mb_local = ids.shape[1]
+        seq_local = s_len // mp if (sp and mp_axis) else s_len
+        h0 = jnp.zeros((mb_local, seq_local, args.hidden_size), self.dtype)
+        vary_axes = ("dp", "pp") + (("mp",) if (sp and mp_axis) else ())
+        h0 = jax.lax.pcast(h0, vary_axes, to="varying")
+        T = M + V * S - 1
+        _, losses = jax.lax.scan(step, h0, jnp.arange(T))
+        total = jnp.sum(losses) / (M * self.dp)
+        total = jax.lax.psum(total, "pp")
+        return total
+
+    # -- 1F1B: hand-scheduled forward/backward (reference
+    #    pipeline_parallel.py:242 PipelineParallel 1F1B) --------------------
+    def _missing_axes(self, spec):
+        """Mesh axes a leaf's grad must be psum'd over in the 1F1B path:
+        'dp' (params replicated over data ranks) and 'pp' for the leaves
+        shared across stages. 'mp' is intentionally absent — the vma type
+        system transposes the mp collectives inside each per-micro-batch vjp
+        (psum for mp-replicated leaves like the norms), exactly as in the
+        AD'd GPipe path."""
+        present = set()
+        for ax in spec:
+            if isinstance(ax, (tuple, list)):
+                present.update(ax)
+            elif ax is not None:
+                present.add(ax)
+        return tuple(ax for ax in ("dp", "pp") if ax not in present)
+
+    def _grads_1f1b(self, lp, ids, labels):
+        """Per-device 1F1B loss+grads. Unlike the GPipe path (AD over the
+        whole micro-step scan, which saves every tick's carry — M+S-1
+        activations), this hand-rolls the schedule: each tick runs at most
+        one forward and one backward micro-batch, backward re-derives the
+        stage vjp from a saved *input* activation (micro-batch-level remat),
+        and the only activation storage is a fixed ring of 2S-1 slots.
+        Param grads accumulate in the scan carry.
+
+        Tick timetable (stage s, micro-batch m):
+          forward(s, m)  at t = s + m
+          backward(s, m) at t = (2S-1-s) + m
+        so a forward activation's lifetime is 2S-1-2s ticks (max 2S-1), and
+        the backward edge from stage s+1 arrives exactly when due.
+        """
+        args, S, M = self.args, self.pp, self.micro_batches
+        mp_axis = "mp" if self.mp > 1 else None
+        mp, sp = self.mp, self.sp
+        stage = jax.lax.axis_index("pp")
+        s_len = ids.shape[-1]
+        hd = args.hidden_size // args.num_heads
+        cos, sin = lf.rope_tables(s_len, hd, args.rope_theta)
+
+        # pvary every param over the mesh axes missing from its spec: the
+        # per-micro-batch vjps then stay collective-free on those axes
+        # (grads come out as *partials*), and ONE final psum per leaf over
+        # the same axes restores the full gradient — instead of a psum per
+        # micro-batch that AD's transpose would otherwise insert.
+        spec_tree = self._spec_tree(lp)
+        lp = jax.tree.map(
+            lambda x, sp_: jax.lax.pcast(x, self._missing_axes(sp_),
+                                         to="varying"),
+            lp, spec_tree, is_leaf=lambda x: isinstance(x, P))
+
+        def stage_layers(lp_, h):
+            return lf.run_layers(lp_["layers"], h, cos, sin, args, mp_axis,
+                                 mp, sp, self.remat)
+
+        embed_mb, head_loss, zero_loss = self._mk_stage_helpers(
+            ids, labels, s_len)
+        down = [(i, i + 1) for i in range(S - 1)]
+        up = [(i + 1, i) for i in range(S - 1)]
+        B = 2 * S - 1  # max in-flight forwards at stage 0
+        mb_local = ids.shape[1]
+        seq_local = s_len // mp if (sp and mp_axis) else s_len
+        h_shape = (mb_local, seq_local, args.hidden_size)
+        vary_axes = ("dp", "pp") + (("mp",) if (sp and mp_axis) else ())
+
+        def vary(x):
+            return jax.lax.pcast(x, vary_axes, to="varying")
+
+        def step(carry, t):
+            h_prev, g_prev, slots, gacc, lacc = carry
+            h_recv = jax.lax.ppermute(h_prev, "pp", down) if S > 1 else h_prev
+            g_recv = jax.lax.ppermute(g_prev, "pp", up) if S > 1 else g_prev
+
+            # ---- forward tick ----
+            f = t - stage
+            f_valid = (f >= 0) & (f < M)
+            f_idx = jnp.clip(f, 0, M - 1)
+            h_in = jax.lax.cond(stage == 0,
+                                lambda op: embed_mb(lp, op[1]) + op[0] * 0,
+                                lambda op: op[0], (h_recv, f_idx))
+            slot = jnp.where(f_valid, f_idx % B, B)  # slot B is the trash can
+            slots = jax.lax.dynamic_update_index_in_dim(slots, h_in, slot, 0)
+            h_out = stage_layers(lp, h_in)
+
+            # ---- backward tick ----
+            b = t - (2 * S - 1 - stage)
+            b_valid = (b >= 0) & (b < M)
+            b_idx = jnp.clip(b, 0, M - 1)
+            h_saved = jax.lax.dynamic_index_in_dim(slots, b_idx % B, 0,
+                                                   keepdims=False)
+
+            def bwd_first(op):
+                g_in, bi, h_sv = op
+
+                def f_(lp_):
+                    return stage_layers(lp_, embed_mb(lp_, bi))
+
+                _, vjp = jax.vjp(f_, lp)
+                (g_lp,) = vjp(g_in)
+                return zero_loss(h_sv), g_lp, g_in * 0
+
+            def bwd_mid(op):
+                g_in, bi, h_sv = op
+                _, vjp = jax.vjp(stage_layers, lp, h_sv)
+                g_lp, g_h = vjp(g_in)
+                return zero_loss(h_sv), g_lp, g_h
+
+            def bwd_last(op):
+                g_in, bi, h_sv = op
+
+                def f_(lp_, h):
+                    return head_loss(lp_, stage_layers(lp_, h), bi)
+
+                loss_mb, vjp = jax.vjp(f_, lp, h_sv)
+                g_lp, g_h = vjp(loss_mb * 0 + 1)  # cotangent with loss's vma
+                return loss_mb + zero_loss(h_sv), g_lp, g_h + g_in * 0
+
+            role = jnp.where(stage == 0, 0, jnp.where(stage == S - 1, 2, 1))
+            loss_mb, g_lp, g_out = jax.lax.switch(
+                role, [bwd_first, bwd_mid, bwd_last],
+                (g_recv, b_idx, h_saved))
+
+            w = b_valid.astype(jnp.float32)
+            gacc = jax.tree.map(lambda a, g: a + w.astype(g.dtype) * g,
+                                gacc, g_lp)
+            lacc = lacc + w * loss_mb
+            return (h_out, g_out, slots, gacc, lacc), None
+
+        h0 = vary(jnp.zeros(h_shape, self.dtype))
+        g0 = vary(jnp.zeros(h_shape, self.dtype))
+        slots0 = vary(jnp.zeros((B + 1,) + h_shape, self.dtype))
+        gacc0 = jax.tree.map(jnp.zeros_like, lp)
+        lacc0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("dp", "pp"),
+                              to="varying")
+        T = M + 2 * S - 1
+        (_, _, _, gacc, lacc), _ = jax.lax.scan(
+            step, (h0, g0, slots0, gacc0, lacc0), jnp.arange(T))
+
+        c = 1.0 / (M * self.dp)
+        loss = jax.lax.psum(lacc, "pp") * c
+        loss = jax.lax.psum(loss, "dp")
+        grads = jax.tree.map(
+            lambda g, sp_: jax.lax.psum(
+                (g.astype(jnp.float32) * c).astype(g.dtype),
+                self._missing_axes(sp_))
+            if self._missing_axes(sp_) else (g.astype(jnp.float32)
+                                             * c).astype(g.dtype),
+            gacc, spec_tree, is_leaf=lambda x: isinstance(x, P))
+        return loss, grads
+
     def _local_grads(self, lp, ids, labels):
         """Loss + grads with collective transposition handled by the vma type
         system (check_vma=True): forward psum/all_gather/psum_scatter
@@ -310,7 +578,9 @@ class HybridParallelEngine:
         the stage-gated embedding/head/final-norm psum over 'pp'). The only
         reduction left for us is dp grad averaging (the reference's
         EagerReducer allreduce, reducer.cc:1089)."""
-        loss, grads = jax.value_and_grad(self._pipeline_loss)(lp, ids, labels)
+        loss_fn = (self._pipeline_loss_vpp if self.schedule == "interleave"
+                   else self._pipeline_loss)
+        loss, grads = jax.value_and_grad(loss_fn)(lp, ids, labels)
         # loss is this rank's 1/dp-scaled contribution: psum = global mean
         loss = jax.lax.psum(loss, "dp")
         return loss, grads
@@ -325,7 +595,11 @@ class HybridParallelEngine:
 
         flat_specs_tree = param_specs
 
-        local = functools.partial(self._local_grads)
+        # 1f1b hand-rolls its backward; gpipe and interleave AD through
+        # their respective schedule loss via _local_grads
+        local = functools.partial(
+            self._grads_1f1b if self.schedule == "1f1b"
+            else self._local_grads)
         shard_mapped = jax.shard_map(
             local, mesh=mesh,
             in_specs=(flat_specs_tree, data_spec, data_spec),
