@@ -66,37 +66,24 @@ from paddle_tpu.hapi.model import Model  # noqa: F401,E402
 from paddle_tpu.framework.io import save, load  # noqa: F401,E402
 from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401,E402
 
-# paddle.DataParallel / paddle.distributed are imported lazily to avoid
-# pulling the whole distributed stack at import time
-def __getattr__(name):
-    if name == "distributed":
-        import paddle_tpu.distributed as dist
+# paddle.DataParallel / paddle.distributed etc. are imported lazily to avoid
+# pulling heavy stacks at import time
+_LAZY_SUBMODULES = ("distributed", "inference", "static", "profiler",
+                    "incubate", "sparse", "linalg", "fft", "signal",
+                    "geometric", "distribution", "quantization", "text")
 
-        return dist
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+
+        mod = importlib.import_module(f"paddle_tpu.{name}")
+        globals()[name] = mod
+        return mod
     if name == "DataParallel":
         from paddle_tpu.distributed.parallel import DataParallel
 
         return DataParallel
-    if name == "inference":
-        import paddle_tpu.inference as inference
-
-        return inference
-    if name == "static":
-        import paddle_tpu.static as static
-
-        return static
-    if name == "profiler":
-        import paddle_tpu.profiler as profiler
-
-        return profiler
-    if name == "incubate":
-        import paddle_tpu.incubate as incubate
-
-        return incubate
-    if name == "sparse":
-        import paddle_tpu.sparse as sparse
-
-        return sparse
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

@@ -1,0 +1,470 @@
+"""paddle.distribution (reference: `python/paddle/distribution/`, ~9.3K LoC
+— Distribution base, Normal/Uniform/Categorical/..., `kl_divergence`
+registry, transforms).
+
+TPU-native: log-probs/entropies are pure jnp expressions (jit- and
+grad-friendly); sampling draws functional PRNG subkeys from the global
+generator, matching the framework's stateful-eager RNG semantics.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply
+from paddle_tpu.framework import random as _rng
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
+    "Gamma", "Dirichlet", "Exponential", "Laplace", "LogNormal",
+    "Multinomial", "Poisson", "Geometric", "Cauchy", "Gumbel",
+    "StudentT", "Binomial", "kl_divergence", "register_kl",
+]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Distribution:
+    """Reference: `distribution/distribution.py` Distribution base."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return apply(jnp.exp, self.log_prob(value), _name="prob")
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def _extend(self, shape):
+        return tuple(shape) + self._batch_shape + self._event_shape
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _data(loc).astype(jnp.float32)
+        self.scale = _data(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(_rng.next_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _data(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        out = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _data(low).astype(jnp.float32)
+        self.high = _data(high).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_rng.next_key(), self._extend(shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _data(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        out = jnp.log(self.high - self.low)
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = jax.nn.log_softmax(_data(logits).astype(jnp.float32))
+        else:
+            p = _data(probs).astype(jnp.float32)
+            self.logits = jnp.log(p / p.sum(-1, keepdims=True))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(jnp.exp(self.logits))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.categorical(
+            _rng.next_key(), self.logits,
+            shape=tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        v = _data(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self.logits, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        p = jnp.exp(self.logits)
+        return Tensor(-(p * self.logits).sum(-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.clip(_data(probs).astype(jnp.float32), 1e-7,
+                               1 - 1e-7)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_rng.next_key(), self._extend(shape))
+        return Tensor((u < self.probs_).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _data(value)
+        return Tensor(v * jnp.log(self.probs_)
+                      + (1 - v) * jnp.log(1 - self.probs_))
+
+    def entropy(self):
+        p = self.probs_
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _data(alpha).astype(jnp.float32)
+        self.beta = _data(beta).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.beta(_rng.next_key(), self.alpha, self.beta,
+                                      self._extend(shape)))
+
+    def log_prob(self, value):
+        v = _data(value)
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v) - lbeta)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _data(concentration).astype(jnp.float32)
+        self.rate = _data(rate).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        g = jax.random.gamma(_rng.next_key(), self.concentration,
+                             self._extend(shape))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        v = _data(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _data(concentration).astype(jnp.float32)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(
+            _rng.next_key(), self.concentration,
+            tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        v = _data(value)
+        a = self.concentration
+        lnorm = (jax.scipy.special.gammaln(a).sum(-1)
+                 - jax.scipy.special.gammaln(a.sum(-1)))
+        return Tensor(((a - 1) * jnp.log(v)).sum(-1) - lnorm)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _data(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        e = jax.random.exponential(_rng.next_key(), self._extend(shape))
+        return Tensor(e / self.rate)
+
+    def log_prob(self, value):
+        v = _data(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _data(loc).astype(jnp.float32)
+        self.scale = _data(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        l = jax.random.laplace(_rng.next_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * l)
+
+    def log_prob(self, value):
+        v = _data(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _data(loc).astype(jnp.float32)
+        self.scale = _data(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(_rng.next_key(), self._extend(shape))
+        return Tensor(jnp.exp(self.loc + self.scale * eps))
+
+    def log_prob(self, value):
+        v = _data(value)
+        logv = jnp.log(v)
+        var = self.scale ** 2
+        return Tensor(-((logv - self.loc) ** 2) / (2 * var) - logv
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        p = _data(probs).astype(jnp.float32)
+        self.probs_ = p / p.sum(-1, keepdims=True)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=()):
+        logits = jnp.log(self.probs_)
+        draws = jax.random.categorical(
+            _rng.next_key(), logits,
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        k = self.probs_.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        v = _data(value)
+        return Tensor(
+            jax.scipy.special.gammaln(jnp.asarray(self.total_count + 1.0))
+            - jax.scipy.special.gammaln(v + 1).sum(-1)
+            + (v * jnp.log(self.probs_)).sum(-1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _data(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.poisson(_rng.next_key(), self.rate,
+                                         self._extend(shape)).astype(
+                                             jnp.float32))
+
+    def log_prob(self, value):
+        v = _data(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate
+                      - jax.scipy.special.gammaln(v + 1))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.clip(_data(probs).astype(jnp.float32), 1e-7,
+                               1 - 1e-7)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_rng.next_key(), self._extend(shape))
+        return Tensor(jnp.floor(jnp.log1p(-u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        v = _data(value)
+        return Tensor(v * jnp.log1p(-self.probs_) + jnp.log(self.probs_))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _data(loc).astype(jnp.float32)
+        self.scale = _data(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        c = jax.random.cauchy(_rng.next_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * c)
+
+    def log_prob(self, value):
+        v = _data(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z ** 2)))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _data(loc).astype(jnp.float32)
+        self.scale = _data(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        g = jax.random.gumbel(_rng.next_key(), self._extend(shape))
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        z = (_data(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _data(df).astype(jnp.float32)
+        self.loc = _data(loc).astype(jnp.float32)
+        self.scale = _data(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        t = jax.random.t(_rng.next_key(), self.df, self._extend(shape))
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        v = _data(value)
+        d = self.df
+        z = (v - self.loc) / self.scale
+        return Tensor(
+            jax.scipy.special.gammaln((d + 1) / 2)
+            - jax.scipy.special.gammaln(d / 2)
+            - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+            - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _data(total_count).astype(jnp.float32)
+        self.probs_ = _data(probs).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs_.shape))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.binomial(
+            _rng.next_key(), self.total_count, self.probs_,
+            self._extend(shape)))
+
+    def log_prob(self, value):
+        v = _data(value)
+        n, p = self.total_count, self.probs_
+        return Tensor(
+            jax.scipy.special.gammaln(n + 1)
+            - jax.scipy.special.gammaln(v + 1)
+            - jax.scipy.special.gammaln(n - v + 1)
+            + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+
+# -- KL divergence registry (reference `distribution/kl.py`) ----------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for "
+            f"({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pr = jnp.exp(p.logits)
+    return Tensor((pr * (p.logits - q.logits)).sum(-1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    r = jnp.log((q.high - q.low) / (p.high - p.low))
+    out = jnp.where((q.low <= p.low) & (p.high <= q.high), r, jnp.inf)
+    return Tensor(out)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a, b = p.probs_, q.probs_
+    return Tensor(a * (jnp.log(a) - jnp.log(b))
+                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = p.rate / q.rate
+    return Tensor(jnp.log(r) + q.rate / p.rate - 1)

@@ -1,0 +1,80 @@
+"""paddle.fft (reference: `python/paddle/fft.py`; kernels
+`paddle/phi/kernels/*/fft_kernel.*` — the fft_c2c / fft_r2c / fft_c2r ops in
+ops.yaml). TPU-native: jnp.fft lowers to XLA FFT HLOs.
+
+Norm semantics follow the reference ("backward" | "ortho" | "forward").
+"""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+    "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn", "fftfreq",
+    "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return None if norm == "backward" else norm
+
+
+def _wrap1(jfn, name):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)), x,
+                     _name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _wrap2(jfn, name):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), x,
+                     _name=name)
+
+    op.__name__ = name
+    return op
+
+
+def _wrapn(jfn, name):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), x,
+                     _name=name)
+
+    op.__name__ = name
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")        # fft_c2c
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")     # fft_r2c
+irfft = _wrap1(jnp.fft.irfft, "irfft")  # fft_c2r
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+fft2 = _wrap2(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x, _name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                 _name="ifftshift")

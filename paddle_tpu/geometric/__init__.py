@@ -1,0 +1,137 @@
+"""paddle.geometric (reference: `python/paddle/geometric/`, ~1.7K LoC;
+kernels `paddle/phi/kernels/*/segment_pool_kernel.*`,
+`graph_send_recv_kernel.*`, `graph_send_ue_recv_kernel.*`).
+
+TPU-native design: every message-passing primitive is a segment reduction,
+which XLA lowers to sorted scatter-adds — `jax.ops.segment_*` on static
+shapes. Graph *sampling* ops (khop/neighbors) are host-side,
+dynamic-shape operations and stay out of the compiled path (see
+OP_COVERAGE.md skips).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+]
+
+
+def _num_segments(ids, n):
+    if n is not None:
+        return int(n)
+    return int(jax.device_get(ids._data.max())) + 1 if ids.shape[0] else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+    return apply(lambda d, i: jax.ops.segment_sum(d, i, num_segments=n),
+                 data, segment_ids, _name="segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+
+    def fn(d, i):
+        s = jax.ops.segment_sum(d, i, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones_like(i, d.dtype), i, num_segments=n)
+        c = c.reshape((-1,) + (1,) * (d.ndim - 1))
+        return s / jnp.maximum(c, 1)
+
+    return apply(fn, data, segment_ids, _name="segment_mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+
+    def fn(d, i):
+        out = jax.ops.segment_max(d, i, num_segments=n)
+        # empty segments: reference returns 0, jax returns -inf
+        return jnp.where(jnp.isfinite(out), out, 0)
+
+    return apply(fn, data, segment_ids, _name="segment_max")
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _num_segments(segment_ids, None)
+
+    def fn(d, i):
+        out = jax.ops.segment_min(d, i, num_segments=n)
+        return jnp.where(jnp.isfinite(out), out, 0)
+
+    return apply(fn, data, segment_ids, _name="segment_min")
+
+
+_REDUCERS = {"sum": jax.ops.segment_sum, "add": jax.ops.segment_sum,
+             "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+
+def _reduce(msg, dst, n, pool):
+    if pool in ("sum", "add"):
+        return jax.ops.segment_sum(msg, dst, num_segments=n)
+    if pool == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones_like(dst, msg.dtype), dst,
+                                num_segments=n)
+        return s / jnp.maximum(c.reshape((-1,) + (1,) * (msg.ndim - 1)), 1)
+    out = _REDUCERS[pool](msg, dst, num_segments=n)
+    return jnp.where(jnp.isfinite(out), out, 0)
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather src features, reduce at dst (reference
+    `geometric/message_passing/send_recv.py` send_u_recv)."""
+    n = out_size or x.shape[0]
+    return apply(lambda a, s, d: _reduce(a[s], d, int(n), reduce_op),
+                 x, src_index, dst_index, _name="send_u_recv")
+
+
+_MSG_OPS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+            "div": jnp.divide}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine src node features with edge features, reduce at dst."""
+    n = out_size or x.shape[0]
+    mop = _MSG_OPS[message_op]
+    return apply(lambda a, e, s, d: _reduce(mop(a[s], e), d, int(n), reduce_op),
+                 x, y, src_index, dst_index, _name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from src (x) and dst (y) node features."""
+    mop = _MSG_OPS[message_op]
+    return apply(lambda a, b, s, d: mop(a[s], b[d]),
+                 x, y, src_index, dst_index, _name="send_uv")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (host-side; reference
+    `geometric/reindex.py`). Runs on host — dynamic output shapes."""
+    import numpy as np
+
+    xs = np.asarray(jax.device_get(x._data))
+    nb = np.asarray(jax.device_get(neighbors._data))
+    # reference semantics: x nodes keep their order first, then new ones
+    order = {v: i for i, v in enumerate(xs)}
+    nxt = len(xs)
+    mapping = {}
+    for v in np.concatenate([xs, nb]):
+        if v not in mapping:
+            if v in order:
+                mapping[v] = order[v]
+            else:
+                mapping[v] = nxt
+                nxt += 1
+    reindex_src = np.asarray([mapping[v] for v in nb], np.int64)
+    reindex_dst = np.repeat(np.arange(len(xs), dtype=np.int64),
+                            np.asarray(jax.device_get(count._data)))
+    out_nodes = np.asarray(sorted(mapping, key=mapping.get), np.int64)
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))

@@ -1,0 +1,23 @@
+"""paddle.linalg namespace (reference: `python/paddle/linalg.py` re-exports
+of `python/paddle/tensor/linalg.py`). Implementations live in
+`paddle_tpu/ops/linalg.py`; this module is the canonical `paddle.linalg.*`
+surface."""
+
+from paddle_tpu.ops.linalg import (  # noqa: F401
+    baddbmm, bincount, cholesky, cholesky_solve, cond, corrcoef, cov, cross,
+    det, dist, dot, eig, eigh, eigvals, eigvalsh, histogram, histogramdd,
+    inverse, lstsq, lu, lu_unpack, matmul, matrix_exp, matrix_norm,
+    matrix_power, matrix_rank, multi_dot, norm, outer, pinv, qr, slogdet,
+    solve, svd, svdvals, triangular_solve, vector_norm,
+)
+
+inv = inverse
+
+__all__ = [
+    "baddbmm", "bincount", "cholesky", "cholesky_solve", "cond", "corrcoef",
+    "cov", "cross", "det", "dist", "dot", "eig", "eigh", "eigvals",
+    "eigvalsh", "histogram", "histogramdd", "inv", "inverse", "lstsq", "lu",
+    "lu_unpack", "matmul", "matrix_exp", "matrix_norm", "matrix_power",
+    "matrix_rank", "multi_dot", "norm", "outer", "pinv", "qr", "slogdet",
+    "solve", "svd", "svdvals", "triangular_solve", "vector_norm",
+]

@@ -200,3 +200,15 @@ def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
 
 def log_sigmoid(x, name=None):
     return apply(jax.nn.log_sigmoid, x, _name="log_sigmoid")
+
+
+def swiglu(x, y=None, name=None):
+    """silu(x) * y; with y=None, x is split in half on the last dim
+    (reference ops.yaml swiglu — the fused SwiGLU the Llama MLP uses)."""
+    if y is None:
+        def fn(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(a1) * a2
+
+        return apply(fn, x, _name="swiglu")
+    return apply(lambda a, b: jax.nn.silu(a) * b, x, y, _name="swiglu")

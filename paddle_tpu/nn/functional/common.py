@@ -270,3 +270,108 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     if bias is not None:
         return apply(fn, x1, x2, weight, bias, _name="bilinear")
     return apply(fn, x1, x2, weight, _name="bilinear")
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW", name=None):
+    """5-D padding (reference ops.yaml pad3d). paddings: 6 ints
+    [front, back, top, bottom, left, right] in reference order
+    [left, right, top, bottom, front, back] for W/H/D."""
+    l, r, t, b, f, bk = paddings
+
+    def fn(a):
+        if data_format == "NCDHW":
+            cfg = [(0, 0), (0, 0), (f, bk), (t, b), (l, r)]
+        else:  # NDHWC
+            cfg = [(0, 0), (f, bk), (t, b), (l, r), (0, 0)]
+        if mode == "constant":
+            return jnp.pad(a, cfg, constant_values=value)
+        m = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+        return jnp.pad(a, cfg, mode=m)
+
+    return apply(fn, x, _name="pad3d")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Affine sampling grid (reference ops.yaml affine_grid). theta:
+    [N, 2, 3] -> grid [N, H, W, 2] (4-D) or [N, 3, 4] -> [N, D, H, W, 3]."""
+    shape = [int(s) for s in
+             (out_shape.numpy() if hasattr(out_shape, "numpy") else out_shape)]
+
+    def lin(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        half = 1.0 - 1.0 / n
+        return jnp.linspace(-half, half, n)
+
+    def fn(th):
+        if len(shape) == 4:
+            n, _, h, w = shape
+            ys, xs = jnp.meshgrid(lin(h), lin(w), indexing="ij")
+            base = jnp.stack([xs, ys, jnp.ones_like(xs)], -1)  # [H, W, 3]
+            return jnp.einsum("hwk,nck->nhwc", base, th)
+        n, _, d, h, w = shape
+        zs, ys, xs = jnp.meshgrid(lin(d), lin(h), lin(w), indexing="ij")
+        base = jnp.stack([xs, ys, zs, jnp.ones_like(xs)], -1)
+        return jnp.einsum("dhwk,nck->ndhwc", base, th)
+
+    return apply(fn, theta, _name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x (NCHW) at grid (N,H',W',2) locations in [-1,1] (reference
+    ops.yaml grid_sample). Gathers vectorize cleanly on TPU."""
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+
+        def unnorm(u, size):
+            if align_corners:
+                return (u + 1) * (size - 1) / 2
+            return ((u + 1) * size - 1) / 2
+
+        fx, fy = unnorm(gx, w), unnorm(gy, h)
+
+        def sample_at(ix, iy):
+            inside = ((ix >= 0) & (ix <= w - 1) & (iy >= 0) & (iy <= h - 1))
+            if padding_mode == "border":
+                ixc = jnp.clip(ix, 0, w - 1)
+                iyc = jnp.clip(iy, 0, h - 1)
+                inside = jnp.ones_like(inside)
+            elif padding_mode == "reflection":
+                def reflect(u, size):
+                    # reflect into [0, size-1] with period 2(size-1)
+                    if size == 1:
+                        return jnp.zeros_like(u)
+                    span = 2.0 * (size - 1)
+                    u = jnp.mod(jnp.abs(u), span)
+                    return jnp.minimum(u, span - u)
+
+                ixc = reflect(ix, w)
+                iyc = reflect(iy, h)
+                inside = jnp.ones_like(inside)
+            else:
+                ixc = jnp.clip(ix, 0, w - 1)
+                iyc = jnp.clip(iy, 0, h - 1)
+            # a: [N,C,H,W]; gather per batch with advanced indexing
+            bidx = jnp.arange(n).reshape(n, 1, 1)
+            vals = a[bidx, :, iyc.astype(jnp.int32), ixc.astype(jnp.int32)]
+            # vals: [N, H', W', C] -> mask and move C forward
+            vals = jnp.where(inside[..., None], vals, 0.0)
+            return jnp.moveaxis(vals, -1, 1)
+
+        if mode == "nearest":
+            return sample_at(jnp.round(fx), jnp.round(fy))
+        x0, y0 = jnp.floor(fx), jnp.floor(fy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - fx) * (y1 - fy)
+        wb = (fx - x0) * (y1 - fy)
+        wc = (x1 - fx) * (fy - y0)
+        wd = (fx - x0) * (fy - y0)
+        return (sample_at(x0, y0) * wa[:, None] +
+                sample_at(x1, y0) * wb[:, None] +
+                sample_at(x0, y1) * wc[:, None] +
+                sample_at(x1, y1) * wd[:, None])
+
+    return apply(fn, x, grid, _name="grid_sample")

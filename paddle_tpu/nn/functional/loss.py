@@ -297,3 +297,12 @@ def dice_loss(input, label, epsilon=1e-5, name=None):
         return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
 
     return apply(fn, input, label, _name="dice")
+
+
+def identity_loss(x, reduction="none", name=None):
+    """Pass-through loss marker (reference ops.yaml identity_loss)."""
+    if reduction in (0, "sum"):
+        return apply(jnp.sum, x, _name="identity_loss")
+    if reduction in (1, "mean"):
+        return apply(jnp.mean, x, _name="identity_loss")
+    return x

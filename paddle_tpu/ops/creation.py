@@ -212,3 +212,21 @@ def poisson(x, name=None):
 def exponential_(x, lam=1.0, name=None):
     x._data = jax.random.exponential(_rng.next_key(), tuple(x.shape), x.dtype) / lam
     return x
+
+
+def standard_gamma(x, name=None):
+    """Sample Gamma(alpha=x, 1) elementwise (reference ops.yaml
+    standard_gamma)."""
+    return Tensor(jax.random.gamma(_rng.next_key(), x._data))
+
+
+def binomial(count, prob, name=None):
+    """Sample Binomial(count, prob) elementwise (reference ops.yaml
+    binomial)."""
+    c = count._data if isinstance(count, Tensor) else jnp.asarray(count)
+    p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
+    out = jax.random.binomial(_rng.next_key(), c.astype(jnp.float32),
+                              p.astype(jnp.float32))
+    # reference returns int64; int32 is the widest default int with
+    # jax_enable_x64 off (framework-wide convention, see dtypes.py)
+    return Tensor(out.astype(jnp.int32))

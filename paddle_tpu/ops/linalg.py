@@ -272,3 +272,49 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         a = a - a.mean(-2, keepdims=True)
     u, s, vh = jnp.linalg.svd(a, full_matrices=False)
     return Tensor(u[..., :q]), Tensor(s[..., :q]), Tensor(jnp.swapaxes(vh, -1, -2)[..., :q])
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) batched (reference ops.yaml baddbmm)."""
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 input, x, y, _name="baddbmm")
+
+
+def svdvals(x, name=None):
+    return apply(lambda a: jnp.linalg.svd(a, compute_uv=False), x,
+                 _name="svdvals")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack (possibly batched) LU factors (reference ops.yaml lu_unpack).
+    Returns (P, L, U); parts not requested via the unpack flags are None."""
+    a = x._data
+    piv = y._data
+    m, n = a.shape[-2], a.shape[-1]
+    k = min(m, n)
+
+    l = u = p = None
+    if unpack_ludata:
+        l = jnp.tril(a[..., :, :k], k=-1) + jnp.eye(m, k, dtype=a.dtype)
+        u = jnp.triu(a[..., :k, :])
+
+    if unpack_pivots:
+        def perm_of(pv):
+            # pivots are 1-based sequential row swaps
+            perm = jnp.arange(m)
+            for i in range(pv.shape[-1]):
+                j = pv[i] - 1
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj).at[j].set(pi)
+            return jnp.eye(m, dtype=a.dtype)[perm].T
+
+        if piv.ndim == 1:
+            p = perm_of(piv)
+        else:
+            batch = piv.shape[:-1]
+            flat = piv.reshape((-1, piv.shape[-1]))
+            p = jax.vmap(perm_of)(flat).reshape(batch + (m, m))
+
+    return (Tensor(p) if p is not None else None,
+            Tensor(l) if l is not None else None,
+            Tensor(u) if u is not None else None)

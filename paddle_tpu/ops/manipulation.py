@@ -489,3 +489,15 @@ def atleast_3d(*inputs, name=None):
             x = unsqueeze(x, -1) if x.ndim >= 2 else unsqueeze(x, 0)
         outs.append(x)
     return outs[0] if len(outs) == 1 else outs
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Split along axis into a list of tensors (reference ops.yaml unstack)."""
+    n = num if num is not None else x.shape[axis]
+    parts = split(x, n, axis=axis)
+    return [squeeze(p, axis=axis) for p in parts]
+
+
+def shape(input, name=None):
+    """Shape as a 1-D int32 tensor (reference ops.yaml shape/shape64)."""
+    return Tensor(jnp.asarray(input.shape, jnp.int32))

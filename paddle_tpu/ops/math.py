@@ -1,6 +1,8 @@
 """Elementwise math + reductions (reference: `python/paddle/tensor/math.py`,
 `python/paddle/tensor/ops.py`)."""
 
+import builtins as _builtins
+
 import jax
 import jax.numpy as jnp
 
@@ -330,3 +332,140 @@ def accuracy_check(x, y, fn_name="", rtol=1e-5, atol=1e-8, equal_nan=False):
     if not ok:
         raise AssertionError(f"accuracy_check failed for {fn_name}")
     return Tensor(jnp.asarray(ok))
+
+
+# -- special functions (reference ops.yaml gammaln/gammaincc/polygamma/i0e/i1e)
+gammaln = _unary(jax.scipy.special.gammaln, "gammaln")
+i0e = _unary(jax.scipy.special.i0e, "i0e")
+i1e = _unary(jax.scipy.special.i1e, "i1e")
+
+
+def gammainc(x, y, name=None):
+    return apply(jax.scipy.special.gammainc, x, y, _name="gammainc")
+
+
+def gammaincc(x, y, name=None):
+    return apply(jax.scipy.special.gammaincc, x, y, _name="gammaincc")
+
+
+def polygamma(x, n, name=None):
+    return apply(lambda a: jax.scipy.special.polygamma(n, a), x,
+                 _name="polygamma")
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference ops.yaml add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = apply(jnp.add, out, t, _name="add_n")
+    return out
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x down to target's shape (reference ops.yaml reduce_as)."""
+    tshape = tuple(target.shape) if hasattr(target, "shape") else tuple(target)
+
+    def fn(a):
+        extra = a.ndim - len(tshape)
+        if extra > 0:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        axes = tuple(i for i, (d, t) in enumerate(zip(a.shape, tshape))
+                     if d != t and t == 1)
+        if axes:
+            a = jnp.sum(a, axis=axes, keepdims=True)
+        return a
+
+    return apply(fn, x, _name="reduce_as")
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """Scale x so its l2 norm is at most max_norm (ops.yaml clip_by_norm)."""
+    def fn(a):
+        n = jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32))))
+        coef = jnp.minimum(max_norm / jnp.maximum(n, 1e-12), 1.0)
+        return (a * coef).astype(a.dtype)
+
+    return apply(fn, x, _name="clip_by_norm")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along `axis` to p-norm <= max_norm (ops.yaml
+    renorm)."""
+    def fn(a):
+        ax = axis % a.ndim
+        red = tuple(i for i in range(a.ndim) if i != ax)
+        norms = jnp.sum(jnp.abs(a.astype(jnp.float32)) ** p, axis=red,
+                        keepdims=True) ** (1.0 / p)
+        coef = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return (a * coef).astype(a.dtype)
+
+    return apply(fn, x, _name="renorm")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), x, _name="diagonal")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """Batched diagonal matrices from the last dim (ops.yaml diag_embed)."""
+    def fn(a):
+        n = a.shape[-1] + _builtins.abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + _builtins.max(-offset, 0)
+        c = idx + _builtins.max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        # diag lives on the last two dims; move them to (dim1, dim2)
+        out = jnp.moveaxis(out, (out.ndim - 2, out.ndim - 1), (d1, d2))
+        return out
+
+    return apply(fn, input, _name="diag_embed")
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Return x with its main diagonal set, matching the reference's
+    semantics (ops.yaml fill_diagonal; the inplace twin is fill_diagonal_):
+    2-D fills the (offset) diagonal, wrap=True continues the diagonal every
+    n+1 rows on tall matrices; ndim>2 requires all dims equal and fills
+    x[i, i, ..., i]."""
+    def fn(a):
+        if a.ndim == 2:
+            m, n = a.shape
+            if offset == 0:
+                # numpy semantics: diagonal = flat stride n+1; wrap=True
+                # continues past row n on tall matrices
+                stop = m * n if (wrap and m > n) else _builtins.min(m, n) * (n + 1)
+                pos = jnp.arange(0, stop, n + 1)
+                return a.ravel().at[pos].set(value).reshape(m, n)
+            d = _builtins.min(m, n) - _builtins.abs(offset)
+            idx = jnp.arange(_builtins.max(d, 0))
+            r = idx + _builtins.max(-offset, 0)
+            c = idx + _builtins.max(offset, 0)
+            return a.at[r, c].set(value)
+        if len(set(a.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal with ndim > 2 requires all dims equal "
+                "(reference fill_diagonal_ kernel)")
+        idx = jnp.arange(a.shape[0])
+        return a.at[tuple([idx] * a.ndim)].set(value)
+
+    return apply(fn, x, _name="fill_diagonal")
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    def fn(a, b):
+        d1, d2 = dim1 % a.ndim, dim2 % a.ndim
+        a2 = jnp.moveaxis(a, (d1, d2), (-2, -1))
+        n = _builtins.min(a2.shape[-2], a2.shape[-1]) - _builtins.abs(offset)
+        idx = jnp.arange(_builtins.max(n, 0))
+        r = idx + _builtins.max(-offset, 0)
+        c = idx + _builtins.max(offset, 0)
+        a2 = a2.at[..., r, c].set(b)
+        return jnp.moveaxis(a2, (-2, -1), (d1, d2))
+
+    return apply(fn, x, y, _name="fill_diagonal_tensor")

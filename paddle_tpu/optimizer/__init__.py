@@ -1,4 +1,5 @@
 from paddle_tpu.optimizer.optimizer import (  # noqa: F401
-    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, RMSProp, Lamb,
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adadelta, Adagrad,
+    RMSProp, Lamb,
 )
 from paddle_tpu.optimizer import lr  # noqa: F401

@@ -190,6 +190,29 @@ class Adagrad(Optimizer):
         p._data = (p._data - lr * g / (jnp.sqrt(m) + self._epsilon)).astype(p.dtype)
 
 
+class Adadelta(Optimizer):
+    """Reference: `python/paddle/optimizer/adadelta.py` (adadelta_ kernel)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update_param(self, p, g, lr):
+        if self._weight_decay:
+            g = g + float(self._weight_decay) * p._data
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_upd = self._acc("avg_squared_update", p)
+        avg_sq = self._rho * avg_sq + (1 - self._rho) * g * g
+        upd = (jnp.sqrt(avg_upd + self._epsilon)
+               / jnp.sqrt(avg_sq + self._epsilon)) * g
+        avg_upd = self._rho * avg_upd + (1 - self._rho) * upd * upd
+        self._set_acc("avg_squared_grad", p, avg_sq)
+        self._set_acc("avg_squared_update", p, avg_upd)
+        p._data = (p._data - lr * upd).astype(p.dtype)
+
+
 class RMSProp(Optimizer):
     def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
                  parameters=None, weight_decay=None, grad_clip=None, name=None):

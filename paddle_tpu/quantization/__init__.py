@@ -1,0 +1,260 @@
+"""paddle.quantization (reference: `python/paddle/quantization/`, ~3.9K LoC
+— QuantConfig/QAT/PTQ factories — plus the fake-quant kernel family in
+`paddle/phi/kernels/fake_quantize_kernel.*` and
+`weight_only_linear_kernel.*`).
+
+TPU-native design: fake-quant is a pure jnp round-trip with a
+straight-through-estimator custom vjp (quantization noise forwards,
+identity gradient back) — the whole point of QAT — so it jits and trains.
+Weight-only PTQ packs int8 weights + per-channel scales; the int8 matmul
+dequantizes into the bf16 MXU path (TPU has no cuBLAS-LT int8 epilogue;
+XLA fuses scale*cast into the matmul).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = [
+    "fake_quantize_abs_max", "fake_quantize_dequantize_abs_max",
+    "fake_channel_wise_quantize_abs_max",
+    "fake_channel_wise_quantize_dequantize_abs_max",
+    "fake_quantize_moving_average_abs_max",
+    "quantize_linear", "dequantize_linear",
+    "weight_quantize", "weight_dequantize", "weight_only_linear",
+    "apply_per_channel_scale",
+    "QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
+]
+
+
+def _ste_round(x):
+    """round with a straight-through gradient."""
+    return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+
+def _fake_q_dq(a, scale, bit_length):
+    bnd = 2 ** (bit_length - 1) - 1
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(_ste_round(a / s * bnd), -bnd, bnd)
+    return q * s / bnd
+
+
+def fake_quantize_abs_max(x, bit_length=8, name=None):
+    """-> (quantized int tensor, scale). Reference fake_quantize_abs_max."""
+    bnd = 2 ** (bit_length - 1) - 1
+    a = x._data
+    scale = jnp.max(jnp.abs(a))
+    q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-9) * bnd), -bnd,
+                 bnd).astype(jnp.int8)
+    return Tensor(q), Tensor(scale)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8, name=None):
+    """Quant-dequant round trip with STE grad (QAT forward)."""
+    def fn(a):
+        scale = jnp.max(jnp.abs(jax.lax.stop_gradient(a)))
+        return _fake_q_dq(a, scale, bit_length)
+
+    return apply(fn, x, _name="fake_quantize_dequantize_abs_max")
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0,
+                                       name=None):
+    bnd = 2 ** (bit_length - 1) - 1
+    a = x._data
+    red = tuple(i for i in range(a.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(a), axis=red)
+    shape = [1] * a.ndim
+    shape[quant_axis] = -1
+    q = jnp.clip(jnp.round(a / jnp.maximum(scale.reshape(shape), 1e-9) * bnd),
+                 -bnd, bnd).astype(jnp.int8)
+    return Tensor(q), Tensor(scale)
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0, name=None):
+    def fn(a):
+        red = tuple(i for i in range(a.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(jax.lax.stop_gradient(a)), axis=red,
+                        keepdims=True)
+        return _fake_q_dq(a, scale, bit_length)
+
+    return apply(fn, x, _name="fake_channel_wise_quantize_dequantize_abs_max")
+
+
+def fake_quantize_moving_average_abs_max(x, state, bit_length=8, rate=0.9,
+                                         name=None):
+    """-> (qdq output, new moving-average scale state)."""
+    cur = jnp.max(jnp.abs(x._data))
+    st = state._data if isinstance(state, Tensor) else jnp.asarray(state)
+    new_state = rate * st + (1 - rate) * cur
+    out = apply(lambda a: _fake_q_dq(a, new_state, bit_length), x,
+                _name="fake_quantize_moving_average_abs_max")
+    return out, Tensor(new_state)
+
+
+def quantize_linear(x, scale, zero_point=0, bit_length=8, quant_axis=-1,
+                    name=None):
+    bnd = 2 ** (bit_length - 1) - 1
+    s = scale._data if isinstance(scale, Tensor) else jnp.asarray(scale)
+    if quant_axis >= 0 and s.ndim:
+        shape = [1] * x._data.ndim
+        shape[quant_axis] = -1
+        s = s.reshape(shape)
+    q = jnp.clip(jnp.round(x._data / jnp.maximum(s, 1e-9)) + zero_point,
+                 -bnd - 1, bnd)
+    return Tensor(q.astype(jnp.int8))
+
+
+def dequantize_linear(x, scale, zero_point=0, quant_axis=-1, name=None):
+    s = scale._data if isinstance(scale, Tensor) else jnp.asarray(scale)
+    if quant_axis >= 0 and s.ndim:
+        shape = [1] * x._data.ndim
+        shape[quant_axis] = -1
+        s = s.reshape(shape)
+    return Tensor((x._data.astype(jnp.float32) - zero_point) * s)
+
+
+def weight_quantize(x, algo="weight_only_int8", name=None):
+    """-> (int8 weight, per-out-channel scale). Reference
+    weight_quantize_kernel; weights are [in, out]."""
+    a = x._data
+    scale = jnp.max(jnp.abs(a), axis=0)
+    q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-9) * 127), -127,
+                 127).astype(jnp.int8)
+    return Tensor(q), Tensor(scale.astype(jnp.float32))
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8", name=None):
+    return Tensor(x._data.astype(jnp.float32) * scale._data / 127.0)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", name=None):
+    """x @ dequant(weight) + bias — the scale*cast fuses into the matmul."""
+    def fn(a, w, s):
+        wf = w.astype(a.dtype) * (s.astype(a.dtype) / 127.0)
+        return a @ wf
+
+    out = apply(fn, x, weight, weight_scale, _name="weight_only_linear")
+    if bias is not None:
+        out = apply(jnp.add, out, bias, _name="bias_add")
+    return out
+
+
+def apply_per_channel_scale(x, scales, name=None):
+    return apply(lambda a, s: a * s, x, scales,
+                 _name="apply_per_channel_scale")
+
+
+# -- QAT / PTQ high-level API (reference quantization/config.py, qat.py) ----
+
+
+class FakeQuanterWithAbsMax:
+    """Per-layer activation/weight fake quanter (QAT observer)."""
+
+    def __init__(self, bit_length=8, moving_rate=0.9):
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self.scale = jnp.zeros(())
+
+    def __call__(self, x):
+        out, new_scale = fake_quantize_moving_average_abs_max(
+            x, Tensor(self.scale), self.bit_length, self.moving_rate)
+        self.scale = new_scale._data
+        return out
+
+
+class QuantConfig:
+    """Reference `quantization/config.py` QuantConfig: which layer types get
+    quantized and with what quanter. The activation/weight quanters act as
+    prototypes — each quantized layer gets a fresh quanter with the same
+    hyperparameters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation or FakeQuanterWithAbsMax()
+        self.weight = weight or FakeQuanterWithAbsMax()
+        self._types = []
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        if not isinstance(layer_types, (list, tuple)):
+            layer_types = [layer_types]
+        self._types.extend(layer_types)
+        if activation is not None:
+            self.activation = activation
+        if weight is not None:
+            self.weight = weight
+
+    def make_activation_quanter(self):
+        proto = self.activation
+        return FakeQuanterWithAbsMax(proto.bit_length, proto.moving_rate)
+
+    def weight_bit_length(self):
+        return self.weight.bit_length
+
+    def quanted_types(self):
+        if self._types:
+            return tuple(self._types)
+        from paddle_tpu import nn
+
+        return (nn.Linear, nn.Conv2D)
+
+
+class QAT:
+    """Quantization-aware training driver (reference quantization/qat.py)."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        # wrap matching leaf layers' forward with weight+activation quanters
+        types = self.config.quanted_types()
+        w_bits = self.config.weight_bit_length()
+        for _, sub in model.named_sublayers():
+            if isinstance(sub, types) and not hasattr(sub, "_qat_wrapped"):
+                sub._qat_wrapped = True
+                orig = sub.forward
+                quanter = self.config.make_activation_quanter()
+
+                def make_fwd(layer, orig_fwd, q):
+                    def fwd(*args, **kwargs):
+                        w = layer.weight
+                        saved = w._data
+                        w._data = fake_quantize_dequantize_abs_max(
+                            Tensor(saved), bit_length=w_bits)._data
+                        try:
+                            return q(orig_fwd(*args, **kwargs))
+                        finally:
+                            w._data = saved
+
+                    return fwd
+
+                sub.forward = make_fwd(sub, orig, quanter)
+        return model
+
+
+class PTQ:
+    """Post-training quantization: observe abs-max, then fold int8 weights
+    (reference quantization/ptq.py)."""
+
+    def __init__(self, config=None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        types = self.config.quanted_types()
+        for _, sub in model.named_sublayers():
+            if isinstance(sub, types) and hasattr(sub, "weight"):
+                q, scale = weight_quantize(sub.weight)
+                sub._quant_weight = q
+                sub._quant_scale = scale
+        return model
+
+    def convert(self, model, inplace=True):
+        """Replace observed weights by their int8 round trip."""
+        for _, sub in model.named_sublayers():
+            if hasattr(sub, "_quant_weight"):
+                sub.weight._data = weight_dequantize(
+                    sub._quant_weight, sub._quant_scale)._data.astype(
+                        sub.weight._data.dtype)
+        return model

@@ -1,0 +1,122 @@
+"""paddle.signal (reference: `python/paddle/signal.py`; the frame /
+overlap_add / stft ops in ops.yaml). Built on jnp strided windowing + the
+fft module so everything jits and differentiates."""
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_data(a, frame_length, hop_length, axis=-1):
+    """Reference layout (`python/paddle/signal.py` frame): axis=-1 maps
+    (..., seq) -> (..., frame_length, n_frames); axis=0 maps (seq, ...) ->
+    (n_frames, frame_length, ...). Only these two axes are supported, as in
+    the reference."""
+    if axis not in (0, -1, a.ndim - 1):
+        raise ValueError("frame: axis must be 0 or -1")
+    if axis == 0:
+        a = jnp.moveaxis(a, 0, -1)
+    n = a.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num) * hop_length
+    idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+    out = a[..., idx]  # [..., n_frames, frame_length]
+    if axis == 0:
+        return jnp.moveaxis(out, (-2, -1), (0, 1))  # [n_frames, fl, ...]
+    return jnp.swapaxes(out, -1, -2)  # [..., frame_length, n_frames]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames (reference ops.yaml frame)."""
+    return apply(lambda a: _frame_data(a, frame_length, hop_length, axis), x,
+                 _name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (ops.yaml overlap_add). Reference layouts: axis=-1
+    takes [..., frame_length, n_frames]; axis=0 takes
+    [n_frames, frame_length, ...]."""
+    if axis not in (0, -1):
+        raise ValueError("overlap_add: axis must be 0 or -1")
+
+    def fn(a):
+        if axis == 0:
+            frames = jnp.moveaxis(a, (0, 1), (-2, -1))  # [..., n_frames, fl]
+        else:
+            frames = jnp.moveaxis(a, -1, -2)  # [..., n_frames, fl]
+        fl, num = frames.shape[-1], frames.shape[-2]
+        n = (num - 1) * hop_length + fl
+        out = jnp.zeros(frames.shape[:-2] + (n,), a.dtype)
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(fl)[None, :]  # [num, fl]
+        out = out.at[..., idx].add(frames)
+        if axis == 0:
+            out = jnp.moveaxis(out, -1, 0)
+        return out
+
+    return apply(fn, x, _name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference signal.py stft)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = window._data if isinstance(window, Tensor) else window
+
+    def fn(a):
+        w = jnp.ones((win_length,), a.dtype) if win is None else win
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        frames = _frame_data(a, n_fft, hop_length)  # [..., n_fft, num]
+        frames = jnp.swapaxes(frames, -1, -2) * w  # [..., num, n_fft]
+        spec = jnp.fft.rfft(frames) if onesided else jnp.fft.fft(frames)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, num_frames]
+
+    return apply(fn, x, _name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    win = window._data if isinstance(window, Tensor) else window
+
+    def fn(s):
+        w = jnp.ones((win_length,), jnp.float32) if win is None else win
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        spec = jnp.swapaxes(s, -1, -2)  # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = (jnp.fft.irfft(spec, n=n_fft) if onesided
+                  else jnp.fft.ifft(spec).real)
+        frames = frames * w
+        num = frames.shape[-2]
+        n = (num - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        out = out.at[..., idx].add(frames)
+        # window envelope normalization (COLA)
+        env = jnp.zeros((n,), frames.dtype).at[idx].add(w * w)
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply(fn, x, _name="istft")

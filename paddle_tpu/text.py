@@ -1,0 +1,74 @@
+"""paddle.text: sequence decoding utilities (reference:
+`python/paddle/text/viterbi_decode.py`; kernel
+`paddle/phi/kernels/viterbi_decode_kernel.*`).
+
+TPU-native: the Viterbi DP is a `lax.scan` over time steps (static control
+flow) followed by a reverse scan for the backtrace — no host round trips.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """Best tag sequence under a linear-chain CRF.
+
+    potentials: [B, T, N] emissions; transition_params: [N, N];
+    lengths: [B] (defaults to full length). Returns (scores [B],
+    paths [B, T]).
+    """
+    em = potentials._data if isinstance(potentials, Tensor) else potentials
+    tr = (transition_params._data
+          if isinstance(transition_params, Tensor) else transition_params)
+    b, t, n = em.shape
+    lens = (lengths._data if isinstance(lengths, Tensor)
+            else jnp.full((b,), t, jnp.int32) if lengths is None
+            else jnp.asarray(lengths))
+
+    def step(carry, xs):
+        alpha, ti = carry
+        emit = xs  # [B, N]
+        # score of arriving at tag j from best i
+        scores = alpha[:, :, None] + tr[None]  # [B, N(from), N(to)]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+        new_alpha = jnp.max(scores, axis=1) + emit
+        # positions past a sequence's length keep their alpha frozen
+        active = (ti < lens)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        best_prev = jnp.where(active, best_prev,
+                              jnp.arange(n)[None, :])
+        return (new_alpha, ti + 1), best_prev
+
+    alpha0 = em[:, 0]
+    (alpha, _), backptrs = jax.lax.scan(
+        step, (alpha0, jnp.ones((b,), jnp.int32)),
+        jnp.moveaxis(em[:, 1:], 1, 0))
+    scores = jnp.max(alpha, axis=-1)
+    last = jnp.argmax(alpha, axis=-1)  # [B]
+
+    def back(carry, bp):
+        tag = carry
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    _, path_rev = jax.lax.scan(back, last, backptrs, reverse=True)
+    paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1), last[:, None]],
+                            axis=1)
+    return Tensor(scores), Tensor(paths.astype(jnp.int64))
+
+
+class ViterbiDecoder:
+    """Reference `text/viterbi_decode.py` ViterbiDecoder layer-style API."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,92 @@
+"""OpTest harness (reference `test/legacy_test/op_test.py:418`):
+
+for each op — run eager, compare against a NumPy reference
+(`op_test.py:1093` assert_allclose), re-run under jax.jit (the reference's
+dygraph-vs-static dual-mode check), and verify gradients against central
+finite differences (`op_test.py:2881` check_grad numeric jacobian).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+class OpTest:
+    """Mix-in: subclass per op family, call self.check(...)."""
+
+    rtol = 1e-5
+    atol = 1e-6
+    grad_rtol = 2e-2
+    grad_atol = 2e-3
+    fd_eps = 1e-3
+    n_probe = 6  # finite-difference coordinates probed per input
+
+    def check(self, fn, np_ref, inputs, grad=True, grad_inputs=None,
+              rtol=None, atol=None, name=""):
+        """fn: paddle op over Tensors; np_ref: same math over np arrays;
+        inputs: list of np arrays (float inputs get grad-checked)."""
+        rtol = rtol or self.rtol
+        atol = atol or self.atol
+        name = name or getattr(fn, "__name__", "op")
+
+        # eager vs numpy reference
+        tensors = [paddle.to_tensor(a) for a in inputs]
+        out = fn(*tensors)
+        expect = np_ref(*inputs)
+        np.testing.assert_allclose(np.asarray(out.numpy()), expect,
+                                   rtol=rtol, atol=atol,
+                                   err_msg=f"{name}: eager vs numpy")
+
+        # jit parity (the reference's static-mode re-run)
+        jitted = jax.jit(lambda *arrs: fn(*[Tensor(a) for a in arrs])._data)
+        np.testing.assert_allclose(np.asarray(jitted(*inputs)), expect,
+                                   rtol=rtol, atol=atol,
+                                   err_msg=f"{name}: jit vs numpy")
+
+        if not grad:
+            return
+
+        # gradient check: tape grad vs central finite differences on a
+        # random scalar projection of the output
+        which = (grad_inputs if grad_inputs is not None
+                 else [i for i, a in enumerate(inputs)
+                       if np.issubdtype(np.asarray(a).dtype, np.floating)])
+        rng = np.random.default_rng(0)
+        proj = rng.normal(size=np.asarray(expect).shape).astype("float32")
+
+        def scalar(*arrs):
+            o = fn(*[Tensor(jnp.asarray(a)) for a in arrs])
+            return float(np.sum(np.asarray(o.numpy()).astype("float64")
+                                * proj))
+
+        ts = [paddle.to_tensor(a) for a in inputs]
+        for t in ts:
+            t.stop_gradient = False
+        o = fn(*ts)
+        loss = (o * paddle.to_tensor(proj)).sum()
+        loss.backward()
+
+        for i in which:
+            g = ts[i].grad
+            assert g is not None, f"{name}: no grad for input {i}"
+            g = np.asarray(g.numpy(), dtype="float64")
+            flat = np.asarray(inputs[i], dtype="float64").ravel()
+            probes = rng.choice(flat.size, size=min(self.n_probe, flat.size),
+                                replace=False)
+            for j in probes:
+                delta = np.zeros_like(flat)
+                delta[j] = self.fd_eps
+                args_p = list(inputs)
+                args_m = list(inputs)
+                args_p[i] = (flat + delta).reshape(inputs[i].shape).astype(
+                    inputs[i].dtype)
+                args_m[i] = (flat - delta).reshape(inputs[i].shape).astype(
+                    inputs[i].dtype)
+                fd = (scalar(*args_p) - scalar(*args_m)) / (2 * self.fd_eps)
+                got = g.ravel()[j]
+                np.testing.assert_allclose(
+                    got, fd, rtol=self.grad_rtol, atol=self.grad_atol,
+                    err_msg=f"{name}: grad[{i}][{j}] tape={got} fd={fd}")
