@@ -153,22 +153,7 @@ def is_float16_supported(device=None):
     return True
 
 
-class debugging:
-    """AMP debugging facade (reference: `python/paddle/amp/debugging.py`)."""
-
-    @staticmethod
-    def enable_operator_stats_collection():
-        pass
-
-    @staticmethod
-    def disable_operator_stats_collection():
-        pass
-
-    @staticmethod
-    def check_numerics(tensor, op_type="", var_name=""):
-        import jax.numpy as _jnp
-
-        bad = bool(_jnp.any(~_jnp.isfinite(tensor._data)))
-        if bad:
-            raise FloatingPointError(f"nan/inf detected in {op_type}:{var_name}")
-        return tensor
+# imported eagerly: the debugging module registers the FLAGS_check_nan_inf
+# watcher at import time — a lazy import would silently ignore the flag for
+# scripts that set it without ever touching paddle.amp.debugging
+from paddle_tpu.amp import debugging  # noqa: F401,E402

@@ -1,0 +1,258 @@
+"""Numerical sanitizers + accuracy-align tooling.
+
+Reference counterparts:
+  - `FLAGS_check_nan_inf` machinery: eager checker
+    `paddle/fluid/eager/nan_inf_utils.cc` + executor checker
+    `paddle/fluid/framework/new_executor/nan_inf_utils.cc`
+  - `python/paddle/amp/debugging.py`: TensorCheckerConfig,
+    enable/disable_tensor_checker, check_numerics, operator stats
+  - `python/paddle/amp/accuracy_compare.py` + the `accuracy_check` op
+    (`paddle/phi/kernels/accuracy_check_kernel.h`): cross-run comparison
+
+TPU-native split: the eager path hooks the `apply()` dispatch waist (one
+finiteness reduction per op output — the analogue of the reference checking
+every kernel output); the compiled path can't peek inside an XLA program,
+so engines call `assert_finite` on the step outputs (loss/grads) after each
+step — a post-step scan, which is also what the reference's executor
+checker amounts to at program granularity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import tensor as _tensor_mod
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.framework import flags as _flags
+
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+    "disable_tensor_checker", "check_numerics", "assert_finite",
+    "enable_operator_stats_collection", "disable_operator_stats_collection",
+    "collect_operator_stats", "compare_accuracy", "tensor_stats",
+]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    """Reference `amp/debugging.py` TensorCheckerConfig (subset that is
+    meaningful here: enable + debug_mode + op skip list)."""
+
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 skipped_op_list=None, **kwargs):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.skipped_op_list = set(skipped_op_list or ())
+
+
+_checker_config = TensorCheckerConfig(enable=False)
+
+
+def _is_concrete(a):
+    return isinstance(a, (np.ndarray, np.generic)) or (
+        isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer))
+
+
+def _sanitize_hook(op_name, arrays):
+    """Installed on the apply() dispatch waist while the checker is on."""
+    cfg = _checker_config
+    if op_name in cfg.skipped_op_list:
+        return
+    for a in arrays:
+        if not _is_concrete(a) or not jnp.issubdtype(a.dtype, jnp.floating):
+            continue
+        bad = int(jax.device_get(jnp.sum(~jnp.isfinite(a))))
+        if bad:
+            msg = (f"[check_nan_inf] op '{op_name}' produced {bad} "
+                   f"non-finite value(s) in output shape {tuple(a.shape)} "
+                   f"dtype {a.dtype}")
+            if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+                raise FloatingPointError(msg)
+            print(msg)
+
+
+def _sync_from_flag():
+    on = bool(_flags.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"])
+    _checker_config.enable = on
+    _tensor_mod._sanitizer = _sanitize_hook if on else None
+
+
+def enable_tensor_checker(checker_config=None):
+    """Reference `amp/debugging.py` enable_tensor_checker: turns on the
+    per-op nan/inf check (FLAGS_check_nan_inf)."""
+    global _checker_config
+    if checker_config is not None:
+        _checker_config = checker_config
+    _checker_config.enable = True
+    _flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# flags.set_flags drives the hook, so FLAGS_check_nan_inf works however it
+# is set (env bootstrap, paddle.set_flags, or the functions above)
+_flags.watch_flag("FLAGS_check_nan_inf", lambda v: _sync_from_flag())
+_sync_from_flag()
+
+
+def check_numerics(x, op_name="", var_name="",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT, name=None):
+    """Count nan/inf in a tensor; abort mode raises (reference
+    check_numerics op, `ops.yaml` + amp/debugging.py:check_numerics —
+    same (tensor, op_type, var_name) positional signature).
+    Returns (num_nan, num_inf) tensors."""
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    num_nan = jnp.sum(jnp.isnan(a))
+    num_inf = jnp.sum(jnp.isinf(a))
+    if _is_concrete(a):
+        n, i = int(jax.device_get(num_nan)), int(jax.device_get(num_inf))
+        if (n or i) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            where = f"{op_name}:{var_name}" if var_name else op_name
+            raise FloatingPointError(
+                f"[check_numerics] '{where}': {n} nan, {i} inf")
+    return Tensor(num_nan), Tensor(num_inf)
+
+
+def assert_finite(tree, where="step"):
+    """Post-step scan for the compiled path: raise if any leaf of a pytree
+    (loss, grads, params) contains nan/inf. Engines call this when
+    FLAGS_check_nan_inf is set."""
+    leaves = jax.tree.leaves(
+        jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t, tree,
+                     is_leaf=lambda t: isinstance(t, Tensor)))
+    for idx, a in enumerate(leaves):
+        if not hasattr(a, "dtype") or not jnp.issubdtype(jnp.asarray(a).dtype,
+                                                         jnp.floating):
+            continue
+        bad = int(jax.device_get(jnp.sum(~jnp.isfinite(jnp.asarray(a)))))
+        if bad:
+            raise FloatingPointError(
+                f"[check_nan_inf] {where}: leaf {idx} has {bad} "
+                f"non-finite value(s)")
+
+
+def checking_enabled():
+    return _checker_config.enable
+
+
+# -- operator stats (reference enable_operator_stats_collection) ------------
+
+_op_stats = None
+
+
+def _stats_hook(op_name, arrays):
+    if _op_stats is None:
+        return
+    for a in arrays:
+        dt = str(getattr(a, "dtype", "?"))
+        key = (op_name, dt)
+        st = _op_stats.setdefault(key, [0, 0, 0])  # calls, nan, inf
+        st[0] += 1
+        if _is_concrete(a) and jnp.issubdtype(a.dtype, jnp.floating):
+            st[1] += int(jax.device_get(jnp.sum(jnp.isnan(a))))
+            st[2] += int(jax.device_get(jnp.sum(jnp.isinf(a))))
+
+
+def enable_operator_stats_collection():
+    """Track per-(op, dtype) call and nan/inf counts through the dispatch
+    waist (reference amp/debugging.py:enable_operator_stats_collection)."""
+    global _op_stats
+    _op_stats = {}
+    prev = _tensor_mod._sanitizer
+
+    def both(op_name, arrays):
+        _stats_hook(op_name, arrays)
+        if prev is not None:
+            prev(op_name, arrays)
+
+    _tensor_mod._sanitizer = both
+
+
+def disable_operator_stats_collection():
+    """Stop collecting and print the summary table (reference prints
+    op_name | dtype | calls | nan | inf)."""
+    global _op_stats
+    stats, _op_stats = _op_stats, None
+    _sync_from_flag()  # restore the plain checker hook (or None)
+    if stats:
+        print(f"{'op':30} {'dtype':10} {'calls':>8} {'nan':>6} {'inf':>6}")
+        for (op, dt), (c, n, i) in sorted(stats.items()):
+            print(f"{op:30} {dt:10} {c:8d} {n:6d} {i:6d}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+# -- accuracy align (reference amp/accuracy_compare.py + accuracy_check) ----
+
+
+def tensor_stats(tree):
+    """Summarize a pytree of tensors -> {path: (shape, mean, std, absmax)}
+    for dumping and later comparison."""
+    flat = jax.tree_util.tree_flatten_with_path(
+        jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t, tree,
+                     is_leaf=lambda t: isinstance(t, Tensor)))[0]
+    out = {}
+    for path, a in flat:
+        a = np.asarray(jax.device_get(a)).astype("float64")
+        out[jax.tree_util.keystr(path)] = (
+            tuple(a.shape), float(a.mean()), float(a.std()),
+            float(np.abs(a).max() if a.size else 0.0))
+    return out
+
+
+def compare_accuracy(run_a, run_b, rtol=1e-5, atol=1e-8, equal_nan=False,
+                     raise_on_mismatch=False):
+    """Cross-run tensor comparison (the reference's `accuracy_check` op +
+    amp/accuracy_compare workflow): run_a/run_b are pytrees (e.g. two runs'
+    state_dicts or grad trees). Returns a list of mismatch records; with
+    raise_on_mismatch the first divergence aborts, like accuracy_check."""
+    fa = jax.tree_util.tree_flatten_with_path(
+        jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t,
+                     run_a, is_leaf=lambda t: isinstance(t, Tensor)))[0]
+    fb_tree = jax.tree.map(lambda t: t._data if isinstance(t, Tensor) else t,
+                           run_b, is_leaf=lambda t: isinstance(t, Tensor))
+    fb = dict(jax.tree_util.tree_flatten_with_path(fb_tree)[0])
+    mismatches = []
+    for path, a in fa:
+        b = fb.get(path)
+        key = jax.tree_util.keystr(path)
+        if b is None:
+            mismatches.append({"tensor": key, "error": "missing in run_b"})
+            continue
+        a = np.asarray(jax.device_get(a))
+        b = np.asarray(jax.device_get(b))
+        if a.shape != b.shape:
+            mismatches.append({"tensor": key, "error":
+                               f"shape {a.shape} vs {b.shape}"})
+            continue
+        if not np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan):
+            diff = np.abs(a.astype("float64") - b.astype("float64"))
+            denom = np.maximum(np.abs(b.astype("float64")), 1e-12)
+            rec = {"tensor": key, "max_abs_diff": float(diff.max()),
+                   "max_rel_diff": float((diff / denom).max()),
+                   "num_diff": int((diff > atol + rtol *
+                                    np.abs(b)).sum())}
+            mismatches.append(rec)
+            if raise_on_mismatch:
+                raise AssertionError(f"accuracy_check failed: {rec}")
+    return mismatches

@@ -358,6 +358,12 @@ def _as_data(x):
     return x._data if isinstance(x, Tensor) else x
 
 
+# Sanitizer hook on the dispatch waist (reference: FLAGS_check_nan_inf
+# checking every kernel output, eager/nan_inf_utils.cc). None when off —
+# installed by paddle_tpu.amp.debugging so the hot path pays one None-check.
+_sanitizer = None
+
+
 def apply(fn, *tensors, _name="op", _nout=None):
     """Run `fn(*arrays) -> array | tuple(arrays)` over Tensor args, recording
     a grad node if grad is enabled and any input requires grad.
@@ -383,6 +389,8 @@ def apply(fn, *tensors, _name="op", _nout=None):
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]
+    if _sanitizer is not None:
+        _sanitizer(_name, outs)
     result = [Tensor(o, stop_gradient=not needs_grad) for o in outs]
 
     if needs_grad:

@@ -401,6 +401,11 @@ class Engine:
         loss, params, opt_state, buffers = step(
             params, opt_state, buffers, sub, lr, inputs, labels)
         self._state = [params, opt_state, buffers]
+        from paddle_tpu.amp import debugging as _dbg
+
+        if _dbg.checking_enabled():  # FLAGS_check_nan_inf post-step scan
+            _dbg.assert_finite(loss, where="Engine.train_batch loss")
+            _dbg.assert_finite(params, where="Engine.train_batch params")
         if hasattr(self.optimizer, "_learning_rate") and hasattr(
                 self.optimizer._learning_rate, "step"):
             self.optimizer._learning_rate.step()

@@ -635,4 +635,9 @@ class HybridParallelEngine:
     def train_batch(self, params, opt_state, ids, labels):
         step = self.build_train_step()
         ids, labels = self.shard_batch(ids, labels)
-        return step(params, opt_state, ids, labels)
+        out = step(params, opt_state, ids, labels)
+        from paddle_tpu.amp import debugging as _dbg
+
+        if _dbg.checking_enabled():  # FLAGS_check_nan_inf post-step scan
+            _dbg.assert_finite(out[0], where="HybridParallelEngine loss")
+        return out

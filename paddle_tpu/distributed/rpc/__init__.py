@@ -1,0 +1,205 @@
+"""paddle.distributed.rpc (reference: `python/paddle/distributed/rpc/rpc.py`
+— init_rpc/rpc_sync/rpc_async/shutdown over a brpc master).
+
+TPU-native design: the reference's brpc agent maps to a small per-worker
+TCP server speaking length-prefixed pickled (fn, args, kwargs) frames, with
+worker discovery through the framework's TCPStore rendezvous (the same
+store the collective bootstrap uses, csrc/store.cc). Futures are
+concurrent.futures on a client thread pool. Within one process (the
+single-controller common case) calls short-circuit locally.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info"]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_state = None
+
+
+class _RpcState:
+    def __init__(self, name, rank, world_size, store):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self.workers = {}
+        self.server = None
+        self.pool = ThreadPoolExecutor(max_workers=8)
+
+
+def _serve(sock):
+    while True:
+        try:
+            conn, _ = sock.accept()
+        except OSError:
+            return
+        threading.Thread(target=_handle, args=(conn,), daemon=True).start()
+
+
+def _recv_all(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed")
+        buf += chunk
+    return buf
+
+
+def _handle(conn):
+    try:
+        while True:
+            head = conn.recv(1)
+            if not head:
+                return
+            (n,) = struct.unpack("<q", head + _recv_all(conn, 7))
+            fn, args, kwargs = pickle.loads(_recv_all(conn, n))
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # travels back to the caller
+                result = (False, e)
+            try:
+                payload = pickle.dumps(result)
+            except Exception as e:  # unpicklable result/exception
+                payload = pickle.dumps(
+                    (False, RuntimeError(
+                        f"rpc result not picklable: {e!r}; original: "
+                        f"{result[1]!r}")))
+            conn.sendall(struct.pack("<q", len(payload)) + payload)
+    except (ConnectionError, OSError):
+        pass
+    finally:
+        conn.close()
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's rpc agent and rendezvous with the others
+    (reference rpc.py:85)."""
+    global _state
+    if _state is not None:
+        raise RuntimeError("rpc already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+                  if world_size is None else world_size)
+
+    # worker server: bind all interfaces, advertise a peer-reachable address
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("0.0.0.0", 0))
+    srv.listen(64)
+    port = srv.getsockname()[1]
+    ip = os.environ.get("PADDLE_LOCAL_IP")
+    if not ip:
+        try:
+            ip = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            ip = "127.0.0.1"
+    threading.Thread(target=_serve, args=(srv,), daemon=True).start()
+
+    store = None
+    if world_size > 1:
+        from paddle_tpu.core.native import TCPStore
+
+        ep = master_endpoint or os.environ.get("PADDLE_MASTER",
+                                               "127.0.0.1:8711")
+        host, p = ep.rsplit(":", 1)
+        store = TCPStore(host, int(p), is_master=(rank == 0),
+                         world_size=world_size)
+        store.set(f"rpc/worker/{rank}",
+                  pickle.dumps(WorkerInfo(name, rank, ip, port)))
+
+    st = _RpcState(name, rank, world_size, store)
+    st.server = srv
+    st.workers[name] = WorkerInfo(name, rank, ip, port)
+    if store is not None:
+        for r in range(world_size):
+            info = pickle.loads(store.get(f"rpc/worker/{r}", timeout=60.0))
+            st.workers[info.name] = info
+        store.barrier("rpc/init", rank=rank, world_size=world_size)
+    _state = st
+    return st
+
+
+def _call_remote(info, fn, args, kwargs, timeout):
+    payload = pickle.dumps((fn, args, kwargs))
+    # reference convention: timeout <= 0 means no timeout
+    to = timeout if (timeout is not None and timeout > 0) else None
+    with socket.create_connection((info.ip, info.port), timeout=to) as conn:
+        conn.sendall(struct.pack("<q", len(payload)) + payload)
+        (n,) = struct.unpack("<q", _recv_all(conn, 8))
+        ok, result = pickle.loads(_recv_all(conn, n))
+    if not ok:
+        raise result
+    return result
+
+
+def _invoke(to, fn, args, kwargs, timeout):
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    args = args or ()
+    kwargs = kwargs or {}
+    info = _state.workers.get(to)
+    if info is None:
+        raise ValueError(f"unknown rpc worker {to!r}")
+    if info.rank == _state.rank:
+        return _state.pool.submit(fn, *args, **kwargs)
+    return _state.pool.submit(_call_remote, info, fn, args, kwargs, timeout)
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=-1):
+    """Blocking remote call (reference rpc.py:160)."""
+    return _invoke(to, fn, args, kwargs, timeout).result(
+        timeout if timeout and timeout > 0 else None)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=-1) -> Future:
+    """Returns a Future with .wait()-compat (reference rpc.py:206)."""
+    fut = _invoke(to, fn, args, kwargs, timeout)
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result  # reference futures expose .wait()
+    return fut
+
+
+def get_worker_info(name):
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return _state.workers[name]
+
+
+def get_all_worker_infos():
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return sorted(_state.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info():
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    return _state.workers[_state.name]
+
+
+def shutdown():
+    """Tear down the agent (reference rpc.py barrier + stop)."""
+    global _state
+    if _state is None:
+        return
+    if _state.store is not None:
+        _state.store.barrier("rpc/shutdown", rank=_state.rank,
+                             world_size=_state.world_size)
+    try:
+        _state.server.close()
+    except OSError:
+        pass
+    _state.pool.shutdown(wait=False)
+    _state = None

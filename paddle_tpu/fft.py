@@ -20,30 +20,30 @@ def _norm(norm):
     return None if norm == "backward" else norm
 
 
-def _wrap1(jfn, name):
+def _wrap1(jfn, op_name):
     def op(x, n=None, axis=-1, norm="backward", name=None):
         return apply(lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)), x,
-                     _name=name)
+                     _name=op_name)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
-def _wrap2(jfn, name):
+def _wrap2(jfn, op_name):
     def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
         return apply(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), x,
-                     _name=name)
+                     _name=op_name)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
-def _wrapn(jfn, name):
+def _wrapn(jfn, op_name):
     def op(x, s=None, axes=None, norm="backward", name=None):
         return apply(lambda a: jfn(a, s=s, axes=axes, norm=_norm(norm)), x,
-                     _name=name)
+                     _name=op_name)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

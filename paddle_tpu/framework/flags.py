@@ -39,9 +39,21 @@ def _bootstrap_from_env():
                 _flags[k] = v
 
 
+_watchers = {}
+
+
+def watch_flag(name, callback):
+    """Register `callback(value)` to fire whenever `name` is set — how
+    subsystems (e.g. the nan/inf sanitizer) react to flag flips without
+    polling the registry on every op."""
+    _watchers.setdefault(name, []).append(callback)
+
+
 def set_flags(flags_dict):
     for k, v in flags_dict.items():
         _flags[k] = v
+        for cb in _watchers.get(k, ()):
+            cb(v)
     # mirror into the native registry so C++ components see the same values
     # (reference: one flags.cc registry shared by both languages)
     try:

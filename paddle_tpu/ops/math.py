@@ -13,25 +13,25 @@ from paddle_tpu.core.tensor import Tensor, apply, to_tensor
 # --------------------------------------------------------------------------
 
 
-def _unary(jfn, name):
+def _unary(jfn, op_name):
     def op(x, name=None):
-        return apply(jfn, x, _name=name)
+        return apply(jfn, x, _name=op_name)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
-def _binary(jfn, name):
+def _binary(jfn, op_name):
     def op(x, y, name=None):
         if isinstance(x, Tensor) and isinstance(y, Tensor):
-            return apply(jfn, x, y, _name=name)
+            return apply(jfn, x, y, _name=op_name)
         if isinstance(x, Tensor):
-            return apply(lambda a: jfn(a, y), x, _name=name)
+            return apply(lambda a: jfn(a, y), x, _name=op_name)
         if isinstance(y, Tensor):
-            return apply(lambda b: jfn(x, b), y, _name=name)
+            return apply(lambda b: jfn(x, b), y, _name=op_name)
         return to_tensor(jfn(x, y))
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -45,12 +45,12 @@ def _axes(axis):
     return int(axis)
 
 
-def _reduction(jfn, name, int_promote=False):
+def _reduction(jfn, op_name, int_promote=False):
     def op(x, axis=None, keepdim=False, name=None):
         ax = _axes(axis)
-        return apply(lambda a: jfn(a, axis=ax, keepdims=keepdim), x, _name=name)
+        return apply(lambda a: jfn(a, axis=ax, keepdims=keepdim), x, _name=op_name)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
