@@ -56,6 +56,7 @@ class TensorCheckerConfig:
 
 
 _checker_config = TensorCheckerConfig(enable=False)
+_op_stats = None  # live per-(op,dtype) counters while stats collection is on
 
 
 def _is_concrete(a):
@@ -81,10 +82,30 @@ def _sanitize_hook(op_name, arrays):
             print(msg)
 
 
+def _install_hook():
+    """Single point that decides the dispatch-waist hook from the current
+    (checker, stats) state — flag flips and stats enable/disable compose
+    instead of overwriting each other."""
+    checker_on = _checker_config.enable
+    stats_on = _op_stats is not None
+    if checker_on and stats_on:
+        def both(op_name, arrays):
+            _stats_hook(op_name, arrays)
+            _sanitize_hook(op_name, arrays)
+
+        _tensor_mod._sanitizer = both
+    elif stats_on:
+        _tensor_mod._sanitizer = _stats_hook
+    elif checker_on:
+        _tensor_mod._sanitizer = _sanitize_hook
+    else:
+        _tensor_mod._sanitizer = None
+
+
 def _sync_from_flag():
     on = bool(_flags.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"])
     _checker_config.enable = on
-    _tensor_mod._sanitizer = _sanitize_hook if on else None
+    _install_hook()
 
 
 def enable_tensor_checker(checker_config=None):
@@ -107,11 +128,11 @@ _flags.watch_flag("FLAGS_check_nan_inf", lambda v: _sync_from_flag())
 _sync_from_flag()
 
 
-def check_numerics(x, op_name="", var_name="",
+def check_numerics(x, op_type="", var_name="",
                    debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT, name=None):
     """Count nan/inf in a tensor; abort mode raises (reference
     check_numerics op, `ops.yaml` + amp/debugging.py:check_numerics —
-    same (tensor, op_type, var_name) positional signature).
+    same (tensor, op_type, var_name) signature).
     Returns (num_nan, num_inf) tensors."""
     a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
     num_nan = jnp.sum(jnp.isnan(a))
@@ -119,7 +140,7 @@ def check_numerics(x, op_name="", var_name="",
     if _is_concrete(a):
         n, i = int(jax.device_get(num_nan)), int(jax.device_get(num_inf))
         if (n or i) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
-            where = f"{op_name}:{var_name}" if var_name else op_name
+            where = f"{op_type}:{var_name}" if var_name else op_type
             raise FloatingPointError(
                 f"[check_numerics] '{where}': {n} nan, {i} inf")
     return Tensor(num_nan), Tensor(num_inf)
@@ -149,8 +170,6 @@ def checking_enabled():
 
 # -- operator stats (reference enable_operator_stats_collection) ------------
 
-_op_stats = None
-
 
 def _stats_hook(op_name, arrays):
     if _op_stats is None:
@@ -170,14 +189,7 @@ def enable_operator_stats_collection():
     waist (reference amp/debugging.py:enable_operator_stats_collection)."""
     global _op_stats
     _op_stats = {}
-    prev = _tensor_mod._sanitizer
-
-    def both(op_name, arrays):
-        _stats_hook(op_name, arrays)
-        if prev is not None:
-            prev(op_name, arrays)
-
-    _tensor_mod._sanitizer = both
+    _install_hook()
 
 
 def disable_operator_stats_collection():
@@ -185,7 +197,7 @@ def disable_operator_stats_collection():
     op_name | dtype | calls | nan | inf)."""
     global _op_stats
     stats, _op_stats = _op_stats, None
-    _sync_from_flag()  # restore the plain checker hook (or None)
+    _install_hook()  # restore the plain checker hook (or None)
     if stats:
         print(f"{'op':30} {'dtype':10} {'calls':>8} {'nan':>6} {'inf':>6}")
         for (op, dt), (c, n, i) in sorted(stats.items()):

@@ -1,0 +1,169 @@
+"""Communication watchdog + heartbeat failure detection.
+
+Reference counterparts:
+  - `CommTaskManager` timeout loop (`paddle/phi/core/distributed/
+    comm_task_manager.cc:152-168`): every collective registers a deadline;
+    hung collectives are reported/aborted instead of hanging silently.
+  - launch supervision / rank-death detection (`launch/controllers/
+    watcher.py`, NCCL abort semantics `nccl_comm_task.cc:234-247`).
+
+TPU-native design: XLA collectives can't be aborted mid-flight, so the
+watchdog's job is *detection and loud failure*: (1) the native deadline
+monitor (`csrc/watchdog.cc`) brackets eager collectives and the compiled
+train step; (2) a heartbeat thread writes `hb/<rank>` to the TCPStore and
+watches peers — a rank that stops heartbeating (crash, OOM, preemption) is
+reported within `miss_limit * interval` seconds, turning a silent
+DCN/barrier hang into an actionable error.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+
+__all__ = ["CommMonitor", "start_comm_monitor", "get_comm_monitor",
+           "stop_comm_monitor", "guard"]
+
+_monitor = None
+
+
+class RankFailure(RuntimeError):
+    pass
+
+
+class CommMonitor:
+    def __init__(self, store, rank, world_size, heartbeat_interval=1.0,
+                 miss_limit=5, on_failure=None, collective_timeout=300.0):
+        from paddle_tpu.core import native
+
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.interval = heartbeat_interval
+        self.miss_limit = miss_limit
+        self.collective_timeout = collective_timeout
+        self.failed_ranks = set()
+        self._on_failure = on_failure
+        self._stop = threading.Event()
+        self._timeouts = []
+        self._wd = None
+        if native.available():
+            self._wd = native.Watchdog(
+                poll_interval=min(1.0, heartbeat_interval),
+                on_timeout=self._on_wd_timeout)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    # -- watchdog bracket for collectives / compiled steps ------------------
+    def _on_wd_timeout(self, name, ms):
+        msg = (f"[comm-watchdog] rank {self.rank}: '{name}' exceeded "
+               f"{ms} ms — peer ranks may be dead or desynchronized "
+               f"(failed so far: {sorted(self.failed_ranks) or 'none'})")
+        self._timeouts.append(name)
+        print(msg, file=sys.stderr, flush=True)
+
+    @contextlib.contextmanager
+    def guard(self, name, timeout=None):
+        """Bracket a communication op with a deadline (reference CommTask
+        registration around every NCCL collective)."""
+        if self._wd is None:
+            yield
+            return
+        self._wd.begin(name, timeout or self.collective_timeout)
+        try:
+            yield
+        finally:
+            self._wd.end(name)
+
+    # -- heartbeats ----------------------------------------------------------
+    def _run(self):
+        # a dead rank's LAST heartbeat value stays readable in the store, so
+        # liveness = "the value keeps advancing", not "the read succeeds"
+        last_value = {}    # rank -> last heartbeat payload seen
+        last_change = {}   # rank -> monotonic time that payload changed
+        started = time.monotonic()
+        grace = self.miss_limit * self.interval
+        while not self._stop.is_set():
+            try:
+                self.store.set(f"hb/{self.rank}", repr(time.time()))
+            except Exception:
+                pass  # the store itself died; peers will notice us missing
+            for r in range(self.world_size):
+                if r == self.rank or r in self.failed_ranks:
+                    continue
+                try:
+                    val = self.store.get(f"hb/{r}", timeout=0.5)
+                except Exception:
+                    val = None
+                now = time.monotonic()
+                if val is not None and val != last_value.get(r):
+                    last_value[r] = val
+                    last_change[r] = now
+                if r in last_change:
+                    stale = now - last_change[r]
+                    if stale > grace:
+                        self._declare_dead(r, stale)
+                elif now - started > 10 * grace:
+                    # never heartbeated at all (died during startup)
+                    self._declare_dead(r, now - started)
+            self._stop.wait(self.interval)
+
+    def _declare_dead(self, r, stale):
+        if r in self.failed_ranks:
+            return
+        self.failed_ranks.add(r)
+        msg = (f"[comm-monitor] rank {self.rank}: rank {r} missed "
+               f"heartbeats for {stale:.1f}s — declaring it DEAD")
+        print(msg, file=sys.stderr, flush=True)
+        if self._on_failure is not None:
+            self._on_failure(r)
+
+    def check_peers(self):
+        """Raise if any peer has been declared dead (call between steps)."""
+        if self.failed_ranks:
+            raise RankFailure(
+                f"rank(s) {sorted(self.failed_ranks)} are dead "
+                f"(no heartbeat); aborting per failure-detection policy")
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        if self._wd is not None:
+            self._wd.stop()
+            self._wd = None
+
+
+def start_comm_monitor(store, rank, world_size, **kwargs):
+    global _monitor
+    if _monitor is not None:
+        return _monitor
+    interval = float(os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "1.0"))
+    _monitor = CommMonitor(store, rank, world_size,
+                           heartbeat_interval=kwargs.pop(
+                               "heartbeat_interval", interval), **kwargs)
+    return _monitor
+
+
+def get_comm_monitor():
+    return _monitor
+
+
+def stop_comm_monitor():
+    global _monitor
+    if _monitor is not None:
+        _monitor.stop()
+        _monitor = None
+
+
+@contextlib.contextmanager
+def guard(name, timeout=None):
+    """Module-level bracket used by the functional collectives and the
+    compiled engines; no-op when no monitor is running."""
+    if _monitor is None:
+        yield
+    else:
+        with _monitor.guard(name, timeout):
+            yield

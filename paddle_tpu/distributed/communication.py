@@ -292,3 +292,31 @@ def barrier(group=None):
 
 def get_backend(group=None):
     return "XLA"
+
+
+# -- watchdog brackets (reference: every NCCL collective registers a
+#    CommTask, comm_task_manager.cc:152) ------------------------------------
+
+from paddle_tpu.distributed import comm_monitor as _comm_monitor  # noqa: E402
+
+
+def _guarded(fn):
+    import functools
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with _comm_monitor.guard(fn.__name__):
+            return fn(*args, **kwargs)
+
+    return wrapper
+
+
+for _n in ("all_reduce", "all_gather", "reduce", "broadcast", "scatter",
+           "reduce_scatter", "alltoall", "alltoall_single", "send", "recv",
+           "barrier", "batch_isend_irecv"):
+    globals()[_n] = _guarded(globals()[_n])
+del _n
+# the async aliases were bound to the raw functions before this loop;
+# rebind them so p2p through isend/irecv gets the same deadline bracket
+isend = send
+irecv = recv

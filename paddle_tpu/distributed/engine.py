@@ -398,8 +398,14 @@ class Engine:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         inputs = self._place_batch(inputs)
         labels = self._place_batch(labels)
-        loss, params, opt_state, buffers = step(
-            params, opt_state, buffers, sub, lr, inputs, labels)
+        from paddle_tpu.distributed import comm_monitor as _cm
+
+        mon = _cm.get_comm_monitor()
+        if mon is not None:
+            mon.check_peers()  # fail fast if a rank died between steps
+        with _cm.guard("compiled_train_step"):
+            loss, params, opt_state, buffers = step(
+                params, opt_state, buffers, sub, lr, inputs, labels)
         self._state = [params, opt_state, buffers]
         from paddle_tpu.amp import debugging as _dbg
 

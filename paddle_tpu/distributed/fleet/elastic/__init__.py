@@ -1,0 +1,233 @@
+"""Elastic training: membership, fault tolerance, scale in/out.
+
+Reference: `python/paddle/distributed/fleet/elastic/manager.py:125-251`
+(ElasticManager over etcd leases/watches) + launch supervision
+(`launch/controllers/watcher.py`).
+
+TPU-native design: etcd is replaced by the framework's own TCPStore
+(csrc/store.cc) — node membership is a set of lease keys each node
+refreshes on a heartbeat thread; a lease whose payload stops advancing is
+expired (same liveness rule as the comm monitor). The manager classifies
+the world as HOLD (waiting for min_np), READY (within [min_np, max_np]),
+SCALED (membership changed since the last sync — restart with the new
+world), or FAILED (below min_np after the grace window). The
+ElasticSupervisor (used by `launch --elastic`) restarts the local trainer
+process on faults and on scale events, up to max_restarts — the watcher's
+job in the reference.
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import subprocess
+import sys
+import threading
+import time
+
+__all__ = ["ElasticStatus", "ElasticLevel", "ElasticManager",
+           "ElasticSupervisor"]
+
+
+class ElasticStatus(enum.Enum):
+    HOLD = "hold"        # below min_np, inside the grace window
+    READY = "ready"      # stable world within [min_np, max_np]
+    SCALED = "scaled"    # membership changed since last sync -> restart
+    FAILED = "failed"    # below min_np after the grace window
+    COMPLETED = "completed"
+
+
+class ElasticLevel:
+    FAULT_TOLERANCE = 1  # fixed np, restart on failure (min == max)
+    ELASTIC = 2          # np may move within [min, max]
+
+
+def _parse_np(np_spec):
+    """'2:4' -> (2, 4); '4' -> (4, 4) (reference _parse_np)."""
+    if isinstance(np_spec, int):
+        return np_spec, np_spec
+    if ":" in str(np_spec):
+        lo, hi = str(np_spec).split(":")
+        return int(lo), int(hi)
+    n = int(np_spec)
+    return n, n
+
+
+class ElasticManager:
+    def __init__(self, store, node_id, np="1", ttl=3.0, grace=None,
+                 job_id="default"):
+        self.store = store
+        self.node_id = str(node_id)
+        self.min_np, self.max_np = _parse_np(np)
+        self.level = (ElasticLevel.ELASTIC if self.max_np > self.min_np
+                      else ElasticLevel.FAULT_TOLERANCE)
+        self.ttl = ttl
+        self.grace = grace if grace is not None else float(
+            os.environ.get("PADDLE_ELASTIC_TIMEOUT", 30.0))
+        self.prefix = f"elastic/{job_id}"
+        self.enable = store is not None
+        self._stop = threading.Event()
+        self._known = {}      # node -> (payload, monotonic-last-change)
+        self._synced = None   # membership at the last sync point
+        self._below_since = None
+        if self.enable:
+            self._register()
+            self._thread = threading.Thread(target=self._beat, daemon=True)
+            self._thread.start()
+
+    # -- membership ----------------------------------------------------------
+    def _key(self, node):
+        return f"{self.prefix}/nodes/{node}"
+
+    def _register(self):
+        self.store.set(self._key(self.node_id), repr(time.time()))
+        # atomic membership registration: ADD allocates a slot index, the
+        # slot key records the node id (no read-modify-write races)
+        idx = self.store.add(f"{self.prefix}/nnodes", 1) - 1
+        self.store.set(f"{self.prefix}/id/{idx}", self.node_id)
+
+    def _known_ids(self):
+        n = self.store.add(f"{self.prefix}/nnodes", 0)
+        known = {self.node_id}
+        for i in range(int(n)):
+            v = self._try_get(f"{self.prefix}/id/{i}")
+            if v is not None:
+                known.add(v.decode())
+        return known
+
+    def _try_get(self, key):
+        try:
+            return self.store.get(key, timeout=0.5)
+        except Exception:
+            return None
+
+    def _beat(self):
+        while not self._stop.is_set():
+            try:
+                self.store.set(self._key(self.node_id), repr(time.time()))
+            except Exception:
+                pass
+            self._stop.wait(self.ttl / 3.0)
+
+    def alive_nodes(self):
+        """Nodes whose lease payload advanced within the ttl window."""
+        known = self._known_ids()
+        now = time.monotonic()
+        alive = []
+        for node in sorted(known):
+            val = self._try_get(self._key(node))
+            if val is None:
+                continue
+            prev = self._known.get(node)
+            if prev is None or prev[0] != val:
+                self._known[node] = (val, now)
+                alive.append(node)
+            elif now - prev[1] <= max(self.ttl, 2.0):
+                alive.append(node)
+        return alive
+
+    # -- status machine (reference manager.watch) ---------------------------
+    def sync(self):
+        """Mark the current membership as the running world."""
+        self._synced = tuple(self.alive_nodes())
+        self._below_since = None
+        return self._synced
+
+    def watch(self):
+        alive = self.alive_nodes()
+        n = len(alive)
+        if n < self.min_np:
+            if self._below_since is None:
+                self._below_since = time.monotonic()
+            if time.monotonic() - self._below_since > self.grace:
+                return ElasticStatus.FAILED
+            return ElasticStatus.HOLD
+        self._below_since = None
+        if self._synced is None:
+            return ElasticStatus.READY
+        if tuple(alive) != self._synced:
+            # any membership change (join, leave, or replacement) means the
+            # running world is stale: restart against the new one
+            return ElasticStatus.SCALED
+        return ElasticStatus.READY
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self.enable:
+            try:
+                self.store.set(f"{self.prefix}/status/{self.node_id}",
+                               "completed" if completed else "failed")
+            except Exception:
+                pass
+
+
+class ElasticSupervisor:
+    """Launch-side watcher (reference launch/controllers/watcher.py +
+    elastic restart loop): run the trainer as a subprocess, restart it on
+    failure or scale events up to max_restarts."""
+
+    def __init__(self, cmd, env=None, env_fn=None, max_restarts=3,
+                 manager=None, poll_interval=0.5, log=print):
+        self.cmd = cmd
+        self.env = env
+        # env_fn(manager) -> env dict, evaluated at EVERY (re)spawn so a
+        # restart after scale-in/out sees the new world size, not the env
+        # snapshot from job start
+        self.env_fn = env_fn
+        self.max_restarts = max_restarts
+        self.manager = manager
+        self.poll_interval = poll_interval
+        self.restarts = 0
+        self.log = log
+
+    def _spawn(self):
+        env = self.env_fn(self.manager) if self.env_fn is not None else self.env
+        return subprocess.Popen(self.cmd, env=env)
+
+    def run(self):
+        """Returns the final exit code."""
+        while True:
+            if self.manager is not None:
+                self.manager.sync()
+            proc = self._spawn()
+            restart = False
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    if rc == 0:
+                        if self.manager is not None:
+                            self.manager.exit(completed=True)
+                        return 0
+                    self.log(f"[elastic] trainer exited rc={rc}")
+                    restart = True
+                    break
+                if self.manager is not None:
+                    status = self.manager.watch()
+                    if status == ElasticStatus.SCALED:
+                        self.log("[elastic] membership changed -> restart "
+                                 "with the new world")
+                        proc.terminate()
+                        try:
+                            proc.wait(timeout=30)
+                        except subprocess.TimeoutExpired:
+                            proc.kill()
+                        restart = True
+                        break
+                    if status == ElasticStatus.FAILED:
+                        self.log("[elastic] world below min_np past grace "
+                                 "-> abort")
+                        proc.terminate()
+                        if self.manager is not None:
+                            self.manager.exit(completed=False)
+                        return 1
+                time.sleep(self.poll_interval)
+            if not restart:
+                return 1
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                self.log(f"[elastic] exceeded max_restarts="
+                         f"{self.max_restarts}; giving up")
+                if self.manager is not None:
+                    self.manager.exit(completed=False)
+                return 1
+            self.log(f"[elastic] restart {self.restarts}/{self.max_restarts}")

@@ -633,9 +633,15 @@ class HybridParallelEngine:
         return (jax.device_put(ids, sharding), jax.device_put(labels, sharding))
 
     def train_batch(self, params, opt_state, ids, labels):
+        from paddle_tpu.distributed import comm_monitor as _cm
+
         step = self.build_train_step()
         ids, labels = self.shard_batch(ids, labels)
-        out = step(params, opt_state, ids, labels)
+        mon = _cm.get_comm_monitor()
+        if mon is not None:
+            mon.check_peers()  # fail fast if a rank died between steps
+        with _cm.guard("compiled_train_step"):
+            out = step(params, opt_state, ids, labels)
         from paddle_tpu.amp import debugging as _dbg
 
         if _dbg.checking_enabled():  # FLAGS_check_nan_inf post-step scan

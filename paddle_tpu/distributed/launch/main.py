@@ -36,6 +36,12 @@ def _parse_args(argv):
     p.add_argument("--devices", "--gpus", dest="devices", default=None)
     p.add_argument("--job_id", default="default")
     p.add_argument("--log_dir", default=None)
+    p.add_argument("--elastic_np", default=None,
+                   help="'min:max' node range — enables elastic supervision "
+                        "(reference fleet/elastic); requires --master")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="restarts on trainer failure/scale (watcher "
+                        "supervision, reference launch/controllers/watcher.py)")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -50,6 +56,39 @@ def launch(argv=None):
     os.environ.setdefault("PADDLE_RANK_IN_NODE", "0")
     if args.master:
         os.environ["PADDLE_MASTER"] = args.master
+
+    if args.elastic_np:
+        # supervised mode: the launcher stays up, runs the trainer as a
+        # child, and restarts it on faults / membership changes
+        if not args.master:
+            raise SystemExit("--elastic_np requires --master host:port")
+        from paddle_tpu.core import native
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, ElasticSupervisor)
+
+        host, port = args.master.rsplit(":", 1)
+        store = native.TCPStore(host, int(port) + 2,
+                                is_master=args.rank == 0,
+                                world_size=args.nnodes)
+        manager = ElasticManager(store, node_id=args.rank,
+                                 np=args.elastic_np, job_id=args.job_id)
+
+        def child_env(mgr):
+            # re-evaluated at every (re)spawn: after scale-in/out the child
+            # must see the NEW world, or its rendezvous barrier waits for
+            # ghosts (reference: elastic rewrites the trainer env per round)
+            env = dict(os.environ)
+            alive = sorted(mgr.alive_nodes()) if mgr is not None else []
+            if alive:
+                env["PADDLE_TRAINERS_NUM"] = str(len(alive))
+                env["PADDLE_TRAINER_ID"] = str(alive.index(str(args.rank)))
+            return env
+
+        sup = ElasticSupervisor(
+            [sys.executable, args.script] + list(args.script_args),
+            env_fn=child_env, max_restarts=args.max_restarts,
+            manager=manager)
+        raise SystemExit(sup.run())
 
     if args.nnodes > 1:
         if not args.master:

@@ -90,6 +90,14 @@ def init_parallel_env():
                                timeout=300.0)
         except Exception:
             _store = None  # fall through to the coordination service alone
+        if _store is not None:
+            # the rendezvous store becomes the default store (reference
+            # parallel.py:1134) and feeds the heartbeat failure detector
+            # (reference CommTaskManager + launch watcher)
+            _collective._set_default_store(_store)
+            from paddle_tpu.distributed import comm_monitor
+
+            comm_monitor.start_comm_monitor(_store, proc_id, nprocs)
         jax.distributed.initialize(
             coordinator_address=master, num_processes=nprocs,
             process_id=proc_id)

@@ -114,7 +114,10 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         ep = master_endpoint or os.environ.get("PADDLE_MASTER",
                                                "127.0.0.1:8711")
         host, p = ep.rsplit(":", 1)
-        store = TCPStore(host, int(p), is_master=(rank == 0),
+        # PADDLE_MASTER's own port belongs to the JAX coordinator; the
+        # framework's store offsets are +1 (init_parallel_env), +2
+        # (elastic), +3 (rpc)
+        store = TCPStore(host, int(p) + 3, is_master=(rank == 0),
                          world_size=world_size)
         store.set(f"rpc/worker/{rank}",
                   pickle.dumps(WorkerInfo(name, rank, ip, port)))

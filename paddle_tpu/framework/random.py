@@ -10,8 +10,18 @@ import threading
 import jax
 
 _lock = threading.Lock()
-_key = jax.random.PRNGKey(0)
+# lazy: materializing a PRNGKey initializes the XLA backend, which must not
+# happen at import time (it would run before jax.distributed.initialize on
+# multi-host, and claim the TPU on a bare `import paddle_tpu`)
+_key = None
 _seed_value = 0
+
+
+def _ensure_key_locked():
+    global _key
+    if _key is None:
+        _key = jax.random.PRNGKey(_seed_value)
+    return _key
 
 
 def seed(s):
@@ -23,7 +33,8 @@ def seed(s):
 
 
 def get_rng_state():
-    return _key
+    with _lock:
+        return _ensure_key_locked()
 
 
 def set_rng_state(state):
@@ -53,12 +64,12 @@ def next_key():
         _trace_key_stack[-1] = k1
         return k2
     with _lock:
-        _key, sub = jax.random.split(_key)
+        _key, sub = jax.random.split(_ensure_key_locked())
     return sub
 
 
 def get_cuda_rng_state():
-    return [_key]
+    return [get_rng_state()]
 
 
 def set_cuda_rng_state(state):

@@ -1,0 +1,130 @@
+"""Elastic manager + launch supervision (reference
+`fleet/elastic/manager.py:125-251`, `launch/controllers/watcher.py`)."""
+
+import os
+import socket
+import sys
+import tempfile
+import time
+
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticLevel, ElasticManager, ElasticStatus, ElasticSupervisor,
+    _parse_np)
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core not built")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _store_pair():
+    port = _free_port()
+    master = native.TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+    worker = native.TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+    return master, worker
+
+
+def test_parse_np_and_levels():
+    assert _parse_np("2:4") == (2, 4)
+    assert _parse_np("3") == (3, 3)
+    assert _parse_np(2) == (2, 2)
+    m, _ = _store_pair()
+    fixed = ElasticManager(m, "a", np="2", job_id="lv1", ttl=0.5)
+    elastic = ElasticManager(m, "b", np="2:4", job_id="lv2", ttl=0.5)
+    assert fixed.level == ElasticLevel.FAULT_TOLERANCE
+    assert elastic.level == ElasticLevel.ELASTIC
+    fixed.exit()
+    elastic.exit()
+
+
+def test_membership_and_scale_detection():
+    """Two nodes join -> READY after sync; one stops heartbeating ->
+    SCALED (membership changed); below min_np past grace -> FAILED."""
+    m_store, w_store = _store_pair()
+    a = ElasticManager(m_store, "node-a", np="1:2", ttl=0.6, grace=2.0,
+                       job_id="job1")
+    b = ElasticManager(w_store, "node-b", np="1:2", ttl=0.6, grace=2.0,
+                       job_id="job1")
+    time.sleep(0.5)
+    assert set(a.alive_nodes()) == {"node-a", "node-b"}
+    a.sync()
+    assert a.watch() == ElasticStatus.READY
+
+    b.exit()  # node-b's lease stops advancing
+    deadline = time.time() + 15
+    status = None
+    while time.time() < deadline:
+        status = a.watch()
+        if status == ElasticStatus.SCALED:
+            break
+        time.sleep(0.3)
+    assert status == ElasticStatus.SCALED
+
+    # resync to the 1-node world: still >= min_np -> READY
+    a.sync()
+    assert a.watch() == ElasticStatus.READY
+    a.exit()
+
+
+def test_below_min_np_fails_after_grace():
+    m_store, w_store = _store_pair()
+    a = ElasticManager(m_store, "n0", np="2:3", ttl=0.5, grace=1.5,
+                       job_id="job2")
+    b = ElasticManager(w_store, "n1", np="2:3", ttl=0.5, grace=1.5,
+                       job_id="job2")
+    time.sleep(0.5)
+    a.sync()
+    b.exit()
+    saw_hold = saw_failed = False
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        s = a.watch()
+        if s == ElasticStatus.HOLD:
+            saw_hold = True
+        if s == ElasticStatus.FAILED:
+            saw_failed = True
+            break
+        time.sleep(0.3)
+    assert saw_failed, "never declared FAILED below min_np"
+    assert saw_hold, "should HOLD during the grace window first"
+    a.exit()
+
+
+def test_supervisor_restarts_failed_trainer():
+    """The watcher restarts a crashing trainer; success on a later attempt
+    ends the loop with rc=0 (reference watcher + restart semantics)."""
+    with tempfile.TemporaryDirectory() as td:
+        flag = os.path.join(td, "attempts")
+        script = os.path.join(td, "trainer.py")
+        open(script, "w").write(
+            "import os, sys\n"
+            f"p = {flag!r}\n"
+            "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+            "open(p, 'w').write(str(n + 1))\n"
+            "sys.exit(0 if n >= 2 else 7)\n")
+        logs = []
+        sup = ElasticSupervisor([sys.executable, script], max_restarts=5,
+                                log=logs.append)
+        rc = sup.run()
+        assert rc == 0
+        assert sup.restarts == 2
+        assert any("restart" in l for l in logs)
+
+
+def test_supervisor_gives_up_after_max_restarts():
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "trainer.py")
+        open(script, "w").write("import sys; sys.exit(3)\n")
+        sup = ElasticSupervisor([sys.executable, script], max_restarts=2,
+                                log=lambda *_: None)
+        assert sup.run() == 1
+        assert sup.restarts == 3  # 2 allowed + the one that gave up
