@@ -1,15 +1,21 @@
 """paddle.io: Dataset/DataLoader (reference: `python/paddle/io/`).
 
-TPU-first dataloading: workers produce host numpy batches; device transfer
-happens at consumption (jnp.asarray) so XLA overlaps H2D with compute via
-async dispatch. Multiprocess loading uses torch-free python multiprocessing
-with prefetch, mirroring `io/dataloader/dataloader_iter.py`.
+TPU-first dataloading: `num_workers>0` forks real worker processes
+(reference `io/dataloader/dataloader_iter.py` `_DataLoaderIterMultiProcess`
++ `worker.py`): index batches are dispatched over per-worker queues, workers
+collate numpy batches onto a shared result queue with ticketed reordering
+and exception propagation, and a buffer-reader thread converts finished
+batches to device arrays ahead of consumption — so host batch prep overlaps
+the device step (XLA's async dispatch covers the H2D copy itself).
 """
 
 import itertools
 import math
+import multiprocessing
+import os
 import queue
 import threading
+import traceback
 
 import numpy as np
 
@@ -258,38 +264,346 @@ def default_collate_fn(batch):
     return batch
 
 
-class _PrefetchIter:
-    """Thread-prefetching iterator (single-process analogue of the reference's
-    `_DataLoaderIterMultiProcess` worker+blocking-queue pipeline)."""
+_PREFETCH_DONE = object()
 
-    def __init__(self, loader, num_prefetch=2):
-        self._loader = loader
-        self._queue = queue.Queue(maxsize=num_prefetch)
-        self._done = object()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
-        self._thread.start()
 
-    def _worker(self):
+def _prefetch_worker(base, convert, out_queue, stop):
+    """Module-level so the thread does NOT hold a reference to the
+    _PrefetchIter — abandoning iteration lets the iterator be GC'd, which
+    stops this thread and (via the base iterator's __del__) joins any
+    worker processes instead of leaking them."""
+    try:
+        for batch in base:
+            item = convert(batch)
+            while not stop.is_set():
+                try:
+                    out_queue.put(item, timeout=0.2)
+                    break
+                except queue.Full:
+                    continue
+            if stop.is_set():
+                shutdown = getattr(base, "shutdown", None)
+                if shutdown is not None:
+                    shutdown()
+                return
+    except BaseException as e:  # propagate into the consumer
         try:
-            for batch in self._loader._iter_batches():
-                self._queue.put(batch)
-        finally:
-            self._queue.put(self._done)
+            out_queue.put(_ExcInfo(e, traceback.format_exc()), timeout=1.0)
+        except queue.Full:
+            pass
+    try:
+        out_queue.put(_PREFETCH_DONE, timeout=1.0)
+    except queue.Full:
+        pass
+
+
+class _PrefetchIter:
+    """Buffer-reader thread: pulls batches from a base iterator and converts
+    them to device tensors ahead of consumption, overlapping host batch prep
+    + H2D with the device step (the reference's buffer reader,
+    `use_buffer_reader`)."""
+
+    def __init__(self, base_iter, convert, num_prefetch=2):
+        self._queue = queue.Queue(maxsize=num_prefetch)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=_prefetch_worker,
+            args=(base_iter, convert, self._queue, self._stop), daemon=True)
+        self._thread.start()
 
     def __iter__(self):
         return self
 
     def __next__(self):
         item = self._queue.get()
-        if item is self._done:
+        if item is _PREFETCH_DONE:
             raise StopIteration
+        if isinstance(item, _ExcInfo):
+            item.reraise()
         return item
+
+    def close(self):
+        self._stop.set()
+
+    def __del__(self):
+        self.close()
+
+
+# -- multiprocess workers (reference io/dataloader/worker.py) ---------------
+
+
+class _ExcInfo:
+    """Carries a worker exception as STRINGS only (reference worker.py):
+    live exception objects may not round-trip pickle through the mp queue
+    — a failed pickle would silently drop the item (hang) or crash the
+    parent-side unpickle."""
+
+    def __init__(self, exc, tb):
+        self.exc_type = type(exc).__name__
+        self.exc_msg = str(exc)
+        self.tb = tb
+
+    def reraise(self):
+        raise RuntimeError(
+            f"DataLoader worker raised {self.exc_type}: "
+            f"{self.exc_msg}\nworker traceback:\n{self.tb}")
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+_worker_info = None
+
+
+def get_worker_info():
+    """Inside a worker process: (id, num_workers, dataset, seed); None in
+    the main process (reference worker.py:get_worker_info)."""
+    return _worker_info
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn,
+                 worker_init_fn, worker_id, num_workers, seed):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed((seed + worker_id) % (2 ** 31))
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        msg = index_queue.get()
+        if msg is None:
+            return
+        ticket, indices = msg
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            data_queue.put((ticket, batch))
+        except BaseException as e:
+            data_queue.put((ticket, _ExcInfo(e, traceback.format_exc())))
+
+
+def _iterable_worker_loop(dataset, data_queue, collate_fn, worker_init_fn,
+                          worker_id, num_workers, seed, batch_size,
+                          drop_last):
+    """IterableDataset worker: consumes every num_workers-th item of its
+    own dataset iterator (round-robin item sharding, reference
+    _IterableDatasetFetcher + worker sharding via worker_info)."""
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
+    np.random.seed((seed + worker_id) % (2 ** 31))
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    try:
+        it = itertools.islice(iter(dataset), worker_id, None, num_workers)
+        local = 0
+        while True:
+            batch = list(itertools.islice(it, batch_size))
+            if not batch or (len(batch) < batch_size and drop_last):
+                break
+            data_queue.put(((worker_id, local), collate_fn(batch)))
+            local += 1
+    except BaseException as e:
+        data_queue.put(((worker_id, -1), _ExcInfo(e, traceback.format_exc())))
+    finally:
+        data_queue.put(((worker_id, None), None))  # exhausted sentinel
+
+
+def _default_mp_ctx():
+    """'fork' on posix (the reference's default; workers never touch the
+    XLA runtime, only dataset code + numpy — though a fork while an XLA
+    thread holds a lock is theoretically hazardous, set
+    PADDLE_LOADER_MP_CTX=spawn to trade startup cost for isolation);
+    'spawn' elsewhere (Windows has no fork)."""
+    env = os.environ.get("PADDLE_LOADER_MP_CTX")
+    if env:
+        return env
+    return "fork" if os.name == "posix" else "spawn"
+
+
+class _MultiprocessIter:
+    """Reference `_DataLoaderIterMultiProcess` (dataloader_iter.py): worker
+    processes + index/data queues + ordered reassembly + worker-death
+    detection."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        self._num_workers = loader.num_workers
+        self._timeout = loader.timeout or 0
+        ctx = multiprocessing.get_context(_default_mp_ctx())
+        self._data_queue = ctx.Queue()
+        self._workers = []
+        self._index_queues = []
+        seed = int(np.random.randint(0, 2 ** 31))
+        self._batches = list(loader.batch_sampler)
+        self._send_idx = 0
+        self._rcvd_idx = 0
+        self._reorder = {}
+        for w in range(self._num_workers):
+            iq = ctx.Queue()
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, iq, self._data_queue,
+                      loader.collate_fn, loader.worker_init_fn, w,
+                      self._num_workers, seed),
+                daemon=True)
+            p.start()
+            self._index_queues.append(iq)
+            self._workers.append(p)
+        # prime the pipeline: prefetch_factor outstanding batches per worker
+        for _ in range(self._num_workers * loader.prefetch_factor):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self._send_idx < len(self._batches):
+            w = self._send_idx % self._num_workers
+            self._index_queues[w].put(
+                (self._send_idx, self._batches[self._send_idx]))
+            self._send_idx += 1
+
+    def _get(self):
+        timeout = self._timeout if self._timeout > 0 else 5.0
+        while True:
+            try:
+                return self._data_queue.get(timeout=timeout)
+            except queue.Empty:
+                dead = [w for w, p in enumerate(self._workers)
+                        if not p.is_alive()]
+                if dead:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited unexpectedly "
+                        f"(killed/OOM?) — reference worker-death handling, "
+                        f"dataloader_iter.py")
+                if self._timeout > 0:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self._timeout}s")
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._rcvd_idx >= len(self._batches):
+            self.shutdown()
+            raise StopIteration
+        while self._rcvd_idx not in self._reorder:
+            ticket, data = self._get()
+            self._reorder[ticket] = data
+        data = self._reorder.pop(self._rcvd_idx)
+        self._rcvd_idx += 1
+        self._dispatch()
+        if isinstance(data, _ExcInfo):
+            self.shutdown()
+            data.reraise()
+        return data
+
+    def shutdown(self):
+        for iq in self._index_queues:
+            try:
+                iq.put(None)
+            except Exception:
+                pass
+        for p in self._workers:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+        self._workers = []
+
+    def __del__(self):
+        self.shutdown()
+
+
+class _MultiprocessIterableIter:
+    """IterableDataset over workers: strict round-robin across worker
+    shards keeps the output deterministic."""
+
+    def __init__(self, loader):
+        self._num_workers = loader.num_workers
+        self._timeout = loader.timeout or 0
+        ctx = multiprocessing.get_context(_default_mp_ctx())
+        self._data_queue = ctx.Queue()
+        self._workers = []
+        seed = int(np.random.randint(0, 2 ** 31))
+        for w in range(self._num_workers):
+            p = ctx.Process(
+                target=_iterable_worker_loop,
+                args=(loader.dataset, self._data_queue, loader.collate_fn,
+                      loader.worker_init_fn, w, self._num_workers, seed,
+                      loader.batch_size or 1,
+                      getattr(loader, "drop_last", False)),
+                daemon=True)
+            p.start()
+            self._workers.append(p)
+        self._buffers = {w: {} for w in range(self._num_workers)}
+        self._next_local = {w: 0 for w in range(self._num_workers)}
+        self._exhausted = set()
+        self._turn = 0
+
+    def __iter__(self):
+        return self
+
+    def _pump(self):
+        timeout = self._timeout if self._timeout > 0 else 5.0
+        try:
+            (w, local), data = self._data_queue.get(timeout=timeout)
+        except queue.Empty:
+            dead = [w for w, p in enumerate(self._workers)
+                    if not p.is_alive() and w not in self._exhausted]
+            if dead:
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker(s) {dead} exited unexpectedly")
+            if self._timeout > 0:
+                self.shutdown()
+                raise RuntimeError(
+                    f"DataLoader timed out after {self._timeout}s")
+            return
+        if local is None:
+            self._exhausted.add(w)
+        elif local == -1:
+            self.shutdown()
+            data.reraise()
+        else:
+            self._buffers[w][local] = data
+
+    def __next__(self):
+        while True:
+            if len(self._exhausted) == self._num_workers and all(
+                    not b for b in self._buffers.values()):
+                self.shutdown()
+                raise StopIteration
+            w = self._turn % self._num_workers
+            want = self._next_local[w]
+            if want in self._buffers[w]:
+                data = self._buffers[w].pop(want)
+                self._next_local[w] += 1
+                self._turn += 1
+                return data
+            if w in self._exhausted:
+                self._turn += 1  # this shard is done; move on
+                continue
+            self._pump()
+
+    def shutdown(self):
+        for p in self._workers:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+        self._workers = []
+
+    def __del__(self):
+        self.shutdown()
 
 
 class DataLoader:
-    """reference: `python/paddle/io/dataloader/dataloader_iter.py` (multiprocess
-    loader). On TPU the loader stays host-side; `num_workers>0` enables thread
-    prefetch (python workers add no value under jit since batches are numpy)."""
+    """reference: `python/paddle/io/dataloader/dataloader_iter.py`.
+    `num_workers>0` forks real worker processes (index queues -> collate ->
+    shared data queue, ordered reassembly, exception propagation and
+    worker-death detection); `use_buffer_reader` additionally runs a
+    device-prefetch thread so host batch prep overlaps the device step."""
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -301,6 +615,9 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.return_list = return_list
+        self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._is_iterable = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -325,11 +642,12 @@ class DataLoader:
         return collated
 
     def _iter_batches(self):
+        """Raw collated (host numpy) batches, single-process."""
         if self._is_iterable:
             it = iter(self.dataset)
             if self.batch_size is None:
                 for item in it:
-                    yield self._to_tensors(self.collate_fn([item]))
+                    yield self.collate_fn([item])
                 return
             while True:
                 batch = list(itertools.islice(it, self.batch_size))
@@ -337,22 +655,24 @@ class DataLoader:
                     return
                 if len(batch) < self.batch_size and getattr(self, "drop_last", False):
                     return
-                yield self._to_tensors(self.collate_fn(batch))
+                yield self.collate_fn(batch)
         else:
             for indices in self.batch_sampler:
                 batch = [self.dataset[i] for i in indices]
-                yield self._to_tensors(self.collate_fn(batch))
+                yield self.collate_fn(batch)
 
     def __iter__(self):
         if self.num_workers and self.num_workers > 0:
-            return _PrefetchIter(self, num_prefetch=self.prefetch_factor)
-        return self._iter_batches()
+            base = (_MultiprocessIterableIter(self) if self._is_iterable
+                    else _MultiprocessIter(self))
+        else:
+            base = self._iter_batches()
+        if self.use_buffer_reader:
+            return _PrefetchIter(base, convert=self._to_tensors,
+                                 num_prefetch=self.prefetch_factor)
+        return (self._to_tensors(b) for b in base)
 
     def __len__(self):
         if self.batch_sampler is not None:
             return len(self.batch_sampler)
         raise TypeError("IterableDataset DataLoader has no len()")
-
-
-def get_worker_info():
-    return None
