@@ -1,4 +1,11 @@
 """fleet.utils (reference fleet/utils/)."""
 
+from paddle_tpu.distributed.fleet.utils import fs  # noqa: F401
 from paddle_tpu.distributed.fleet.utils import sequence_parallel_utils  # noqa: F401
+from paddle_tpu.distributed.fleet.utils import tensor_fusion_helper  # noqa: F401
+from paddle_tpu.distributed.fleet.utils import timer_helper  # noqa: F401
 from paddle_tpu.distributed.fleet.recompute import recompute  # noqa: F401
+from paddle_tpu.distributed.fleet.utils.fs import HDFSClient, LocalFS  # noqa: F401
+from paddle_tpu.distributed.fleet.utils.timer_helper import (  # noqa: F401
+    get_timers, set_timers,
+)

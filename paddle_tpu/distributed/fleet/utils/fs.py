@@ -1,0 +1,181 @@
+"""Distributed filesystem clients (reference:
+`python/paddle/distributed/fleet/utils/fs.py` — LocalFS + HDFSClient over
+the hadoop CLI, used by checkpoint save/load on shared storage)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "FSFileExistsError",
+           "FSFileNotExistsError"]
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """reference fs.py LocalFS — full local implementation."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            if os.path.isdir(os.path.join(fs_path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def mv(self, src, dst, overwrite=False, test_exists=True):
+        if test_exists and not self.is_exist(src):
+            raise FSFileNotExistsError(src)
+        if not overwrite and self.is_exist(dst):
+            raise FSFileExistsError(dst)
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        shutil.move(src, dst)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        open(fs_path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient(FS):
+    """reference fs.py HDFSClient — shells out to the hadoop CLI. Raises a
+    clear error when hadoop is not installed (no silent stubbing)."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300,
+                 sleep_inter=1000):
+        self._hadoop = os.path.join(hadoop_home, "bin", "hadoop") \
+            if hadoop_home else "hadoop"
+        self._configs = []
+        for k, v in (configs or {}).items():
+            self._configs += ["-D", f"{k}={v}"]
+        self._timeout = time_out
+
+    def _run(self, *args, check=True):
+        cmd = [self._hadoop, "fs"] + self._configs + list(args)
+        try:
+            return subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=self._timeout, check=check)
+        except FileNotFoundError as e:
+            raise RuntimeError(
+                "HDFSClient requires the hadoop CLI on PATH (or pass "
+                "hadoop_home); it is not installed here") from e
+
+    def is_exist(self, fs_path):
+        return self._run("-test", "-e", fs_path, check=False).returncode == 0
+
+    def is_file(self, fs_path):
+        return self._run("-test", "-f", fs_path, check=False).returncode == 0
+
+    def is_dir(self, fs_path):
+        return self._run("-test", "-d", fs_path, check=False).returncode == 0
+
+    def ls_dir(self, fs_path):
+        out = self._run("-ls", fs_path, check=False).stdout.splitlines()
+        dirs, files = [], []
+        for line in out:
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run("-mkdir", "-p", fs_path)
+
+    def delete(self, fs_path):
+        self._run("-rm", "-r", "-f", fs_path, check=False)
+
+    def upload(self, local_path, fs_path):
+        self._run("-put", local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self._run("-get", fs_path, local_path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and self.is_exist(dst):
+            self.delete(dst)
+        self._run("-mv", src, dst)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        self._run("-touchz", fs_path)

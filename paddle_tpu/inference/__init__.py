@@ -153,6 +153,12 @@ class Predictor:
         self._params = [jax.device_put(state[k], dev) for k in self._param_keys]
         self._inputs = {n: PredictorHandle(n) for n in self._input_names}
         self._outputs = {n: PredictorHandle(n) for n in self._output_names}
+        # deploy dtypes per input (the export is dtype-exact; the handle
+        # accepts any host dtype and casts, like the reference's typed
+        # input tensors)
+        self._input_dtypes = [
+            a.dtype for a in self._exported.in_avals[-len(self._input_names):]
+        ] if self._input_names else []
 
     def get_input_names(self):
         return list(self._input_names)
@@ -171,7 +177,11 @@ class Predictor:
         if inputs is not None:  # positional list form
             for h, arr in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(np.asarray(arr))
-        args = self._params + [self._inputs[n]._array for n in self._input_names]
+        import jax.numpy as jnp
+
+        feeds = [jnp.asarray(self._inputs[n]._array, dtype=dt)
+                 for n, dt in zip(self._input_names, self._input_dtypes)]
+        args = self._params + feeds
         out = self._exported.call(*args)
         outs = list(out) if isinstance(out, (list, tuple)) else [out]
         if len(outs) != len(self._output_names):

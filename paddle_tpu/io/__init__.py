@@ -267,34 +267,35 @@ def default_collate_fn(batch):
 _PREFETCH_DONE = object()
 
 
+def _put_until_stop(out_queue, item, stop):
+    """Blocking put that aborts when the consumer abandoned us; True if
+    delivered."""
+    while not stop.is_set():
+        try:
+            out_queue.put(item, timeout=0.2)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
 def _prefetch_worker(base, convert, out_queue, stop):
     """Module-level so the thread does NOT hold a reference to the
     _PrefetchIter — abandoning iteration lets the iterator be GC'd, which
     stops this thread and (via the base iterator's __del__) joins any
-    worker processes instead of leaking them."""
+    worker processes instead of leaking them. The done/exception sentinels
+    use the same stop-aware put as batches: a full queue must never drop
+    them (the consumer would block forever)."""
     try:
         for batch in base:
-            item = convert(batch)
-            while not stop.is_set():
-                try:
-                    out_queue.put(item, timeout=0.2)
-                    break
-                except queue.Full:
-                    continue
-            if stop.is_set():
+            if not _put_until_stop(out_queue, convert(batch), stop):
                 shutdown = getattr(base, "shutdown", None)
                 if shutdown is not None:
                     shutdown()
                 return
     except BaseException as e:  # propagate into the consumer
-        try:
-            out_queue.put(_ExcInfo(e, traceback.format_exc()), timeout=1.0)
-        except queue.Full:
-            pass
-    try:
-        out_queue.put(_PREFETCH_DONE, timeout=1.0)
-    except queue.Full:
-        pass
+        _put_until_stop(out_queue, _ExcInfo(e, traceback.format_exc()), stop)
+    _put_until_stop(out_queue, _PREFETCH_DONE, stop)
 
 
 class _PrefetchIter:
@@ -385,12 +386,14 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn,
             data_queue.put((ticket, _ExcInfo(e, traceback.format_exc())))
 
 
-def _iterable_worker_loop(dataset, data_queue, collate_fn, worker_init_fn,
-                          worker_id, num_workers, seed, batch_size,
-                          drop_last):
-    """IterableDataset worker: consumes every num_workers-th item of its
-    own dataset iterator (round-robin item sharding, reference
-    _IterableDatasetFetcher + worker sharding via worker_info)."""
+def _iterable_worker_loop(dataset, data_queue, worker_init_fn, worker_id,
+                          num_workers, seed):
+    """IterableDataset worker: consumes every num_workers-th ITEM of its
+    own dataset iterator (round-robin item sharding). Items — not batches —
+    go to the parent, which reassembles the exact single-process item order
+    and batches globally, so batch boundaries and drop_last semantics do
+    not depend on num_workers. The bounded data queue provides
+    backpressure (blocking put) against a slow consumer."""
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset, seed)
     np.random.seed((seed + worker_id) % (2 ** 31))
@@ -398,13 +401,8 @@ def _iterable_worker_loop(dataset, data_queue, collate_fn, worker_init_fn,
         worker_init_fn(worker_id)
     try:
         it = itertools.islice(iter(dataset), worker_id, None, num_workers)
-        local = 0
-        while True:
-            batch = list(itertools.islice(it, batch_size))
-            if not batch or (len(batch) < batch_size and drop_last):
-                break
-            data_queue.put(((worker_id, local), collate_fn(batch)))
-            local += 1
+        for local, item in enumerate(it):
+            data_queue.put(((worker_id, local), item))
     except BaseException as e:
         data_queue.put(((worker_id, -1), _ExcInfo(e, traceback.format_exc())))
     finally:
@@ -420,7 +418,11 @@ def _default_mp_ctx():
     env = os.environ.get("PADDLE_LOADER_MP_CTX")
     if env:
         return env
-    return "fork" if os.name == "posix" else "spawn"
+    import sys
+
+    if os.name != "posix" or sys.platform == "darwin":
+        return "spawn"  # no fork on Windows; fork is unsafe on macOS
+    return "fork"
 
 
 class _MultiprocessIter:
@@ -517,23 +519,28 @@ class _MultiprocessIter:
 
 
 class _MultiprocessIterableIter:
-    """IterableDataset over workers: strict round-robin across worker
-    shards keeps the output deterministic."""
+    """IterableDataset over workers: items stream back over a BOUNDED
+    queue (backpressure) and are reassembled into the exact single-process
+    item order, then batched globally — batch boundaries and drop_last do
+    not depend on num_workers."""
 
     def __init__(self, loader):
         self._num_workers = loader.num_workers
         self._timeout = loader.timeout or 0
+        self._collate = loader.collate_fn
+        self._batch_size = loader.batch_size or 1
+        self._drop_last = getattr(loader, "drop_last", False)
         ctx = multiprocessing.get_context(_default_mp_ctx())
-        self._data_queue = ctx.Queue()
+        self._data_queue = ctx.Queue(
+            maxsize=self._num_workers * loader.prefetch_factor
+            * self._batch_size)
         self._workers = []
         seed = int(np.random.randint(0, 2 ** 31))
         for w in range(self._num_workers):
             p = ctx.Process(
                 target=_iterable_worker_loop,
-                args=(loader.dataset, self._data_queue, loader.collate_fn,
-                      loader.worker_init_fn, w, self._num_workers, seed,
-                      loader.batch_size or 1,
-                      getattr(loader, "drop_last", False)),
+                args=(loader.dataset, self._data_queue,
+                      loader.worker_init_fn, w, self._num_workers, seed),
                 daemon=True)
             p.start()
             self._workers.append(p)
@@ -569,12 +576,12 @@ class _MultiprocessIterableIter:
         else:
             self._buffers[w][local] = data
 
-    def __next__(self):
+    def _next_item(self):
+        """Items in global order: item i came from worker i % num_workers."""
         while True:
             if len(self._exhausted) == self._num_workers and all(
                     not b for b in self._buffers.values()):
-                self.shutdown()
-                raise StopIteration
+                return None
             w = self._turn % self._num_workers
             want = self._next_local[w]
             if want in self._buffers[w]:
@@ -583,9 +590,25 @@ class _MultiprocessIterableIter:
                 self._turn += 1
                 return data
             if w in self._exhausted:
-                self._turn += 1  # this shard is done; move on
+                # shard done; if every shard is done the check above ends it
+                if all(r in self._exhausted
+                       for r in range(self._num_workers)):
+                    continue
+                self._turn += 1
                 continue
             self._pump()
+
+    def __next__(self):
+        batch = []
+        while len(batch) < self._batch_size:
+            item = self._next_item()
+            if item is None:
+                break
+            batch.append(item)
+        if not batch or (len(batch) < self._batch_size and self._drop_last):
+            self.shutdown()
+            raise StopIteration
+        return self._collate(batch)
 
     def shutdown(self):
         for p in self._workers:

@@ -220,8 +220,16 @@ def save(layer, path, input_spec=None, **configs):
         for i, spec in enumerate(input_spec):
             dims = []
             for d in list(spec.shape):
-                if d is None or d == -1:
-                    dims.append(f"dyn{n_sym}")
+                if isinstance(d, str):
+                    # named dynamic dim: the same name across specs shares
+                    # one symbol, so e.g. every input's "batch" must agree
+                    if d.startswith("_autodim"):
+                        raise ValueError(
+                            f"dim name {d!r} collides with the auto-"
+                            "generated symbol namespace (_autodimN)")
+                    dims.append(d)
+                elif d is None or d == -1:
+                    dims.append(f"_autodim{n_sym}")
                     n_sym += 1
                 else:
                     dims.append(str(int(d)))

@@ -12,7 +12,18 @@ from paddle_tpu.core.tensor import Tensor, apply, apply_multi, to_tensor
 def _int_shape(shape):
     if isinstance(shape, Tensor):
         return tuple(int(v) for v in shape.numpy())
-    return tuple(int(s.item()) if isinstance(s, Tensor) else int(s) for s in shape)
+    out = []
+    for s in shape:
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        else:
+            try:
+                out.append(int(s))
+            except Exception:
+                # symbolic dimension (jax.export shape polymorphism):
+                # pass through — jnp handles DimExpr shapes natively
+                out.append(s)
+    return tuple(out)
 
 
 def reshape(x, shape, name=None):

@@ -43,6 +43,25 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
 
+_warned_static_noop = False
+
+
+def _warn_static_noop(api):
+    """Static-graph capture is a different execution model; on this build
+    ops under these guards run EAGERLY (jit/to_static is the compiled
+    path). Warn once instead of silently diverging."""
+    global _warned_static_noop
+    if not _warned_static_noop:
+        import warnings
+
+        warnings.warn(
+            f"paddle.static.{api}: static-graph capture is not implemented "
+            "on the TPU build — ops run eagerly with identical math; use "
+            "paddle.jit.to_static / jit.save for the compiled path. "
+            "(warned once)", stacklevel=3)
+        _warned_static_noop = True
+
+
 class Program:
     """Source-compat Program object; ops under its guard run eagerly."""
 
@@ -74,7 +93,7 @@ def default_startup_program():
 
 class program_guard:
     def __init__(self, main_program=None, startup_program=None):
-        pass
+        _warn_static_noop("program_guard")
 
     def __enter__(self):
         return self

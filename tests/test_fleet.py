@@ -231,3 +231,83 @@ def test_sequence_parallel_linears_match_dense():
     ref = (x.numpy() @ col.weight.numpy() + col.bias.numpy()) @ \
         row.weight.numpy() + row.bias.numpy()
     np.testing.assert_allclose(out.numpy(), ref, rtol=2e-5, atol=2e-5)
+
+
+# -- fleet.utils: timers / tensor fusion / fs (reference fleet/utils/) -------
+
+
+def test_timer_helper():
+    import time
+
+    from paddle_tpu.distributed.fleet.utils import timer_helper
+
+    timers = timer_helper.set_timers()
+    assert timer_helper.get_timers() is timers
+    timers("fwd").start()
+    time.sleep(0.01)
+    timers("fwd").stop()
+    e = timers("fwd").elapsed(reset=False)
+    assert e >= 0.01
+    line = timers.log(["fwd"])
+    assert "fwd" in line and "ms" in line
+
+
+def test_tensor_fusion_helper():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.utils import tensor_fusion_helper as tf
+
+    ps = [paddle.to_tensor(np.full((4,), i, "float32")) for i in range(3)]
+    flat, specs = tf.flatten_dense_tensors(ps)
+    assert flat.shape == [12]
+    back = tf.split_flat_tensor(flat, specs)
+    for i, t in enumerate(back):
+        np.testing.assert_allclose(t.numpy(), np.full((4,), i, "float32"))
+
+    groups = tf.assign_group_by_size(ps, group_size=4 * 4 * 2)
+    assert len(groups) == 2 and len(groups[0]) == 2
+
+    # GradStorage pack/unpack round trip
+    for p in ps:
+        p.grad = paddle.to_tensor(np.ones((4,), "float32"))
+    storage = tf.GradStorage(ps)
+    packed = storage.pack_grads()
+    assert packed.shape == [12]
+    storage.unpack_to_grads(paddle.to_tensor(packed.numpy() * 2))
+    np.testing.assert_allclose(ps[0].grad.numpy(), np.full((4,), 2.0,
+                                                           "float32"))
+
+
+def test_local_fs(tmp_path):
+    from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d)
+    f = str(tmp_path / "a" / "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"] and files == ["x.txt"]
+    fs.mv(f, str(tmp_path / "a" / "y.txt"))
+    assert not fs.is_exist(f) and fs.is_file(str(tmp_path / "a" / "y.txt"))
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_static_program_guard_warns_once():
+    import warnings
+
+    import paddle_tpu.static as static
+
+    static._warned_static_noop = False
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        with static.program_guard(static.Program()):
+            pass
+        with static.program_guard(static.Program()):
+            pass
+    msgs = [w for w in rec if "static-graph capture" in str(w.message)]
+    assert len(msgs) == 1  # warned exactly once

@@ -189,6 +189,10 @@ def test_multiprocess_loader_propagates_worker_exception():
         list(loader)
 
 
+@pytest.mark.skipif(
+    __import__("paddle_tpu.io", fromlist=["_default_mp_ctx"])
+    ._default_mp_ctx() != "fork",
+    reason="spawn start-up cost dominates the timing; fork-only check")
 def test_multiprocess_loader_overlaps_input_pipeline():
     """4 workers on a slow dataset must beat single-process by a wide
     margin (the input pipeline is no longer serialized)."""
@@ -235,3 +239,22 @@ def test_worker_init_fn_and_worker_info():
     out = [int(b.numpy()) for b in loader]
     assert set(out) <= {0, 1}
     assert paddle.io.get_worker_info() is None  # main process
+
+
+def test_iterable_multiprocess_matches_single_process_batches():
+    """Batch boundaries and drop_last must not depend on num_workers
+    (items are reassembled in global order and batched once)."""
+    class Stream(paddle.io.IterableDataset):
+        def __iter__(self):
+            for i in range(20):
+                yield np.asarray([i], "int64")
+
+    def run(num_workers, drop_last):
+        loader = paddle.io.DataLoader(Stream(), batch_size=3,
+                                      num_workers=num_workers,
+                                      drop_last=drop_last)
+        return [b.numpy().ravel().tolist() for b in loader]
+
+    assert run(3, False) == run(0, False)
+    assert run(3, True) == run(0, True)
+    assert len(run(3, True)) == 6  # 20 // 3, dropped once globally

@@ -237,3 +237,63 @@ def test_rpc_local_and_wire():
             rpc._call_remote(info, operator.truediv, (1, 0), {}, 10.0)
     finally:
         rpc.shutdown()
+
+
+def test_config5_unet_bf16_through_predictor(tmp_path):
+    """Config 5 (BASELINE): diffusion UNet in bf16 through jit.save ->
+    StableHLO -> inference Predictor, batch-dynamic, output parity vs the
+    eager model (reference AnalysisPredictor pipeline,
+    inference_api.cc:1119)."""
+    import paddle_tpu.inference as infer
+    from paddle_tpu.jit import save as jit_save
+    from paddle_tpu.models.unet import unet_tiny
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    model = unet_tiny()
+    # bf16 deploy precision (reference runs the SD UNet in fp16; bf16 is
+    # the TPU-native half precision)
+    for _, p in model.named_parameters():
+        p._data = p._data.astype(jnp.bfloat16)
+    model.eval()
+
+    path = str(tmp_path / "unet" / "model")
+    jit_save(model, path, input_spec=[
+        InputSpec(["batch", 4, 32, 32], "bfloat16", "latents"),
+        InputSpec(["batch"], "float32", "timestep"),
+    ])
+
+    config = infer.Config(path)
+    config.enable_memory_optim()
+    predictor = infer.create_predictor(config)
+
+    rng = np.random.default_rng(0)
+    lat = rng.normal(size=(2, 4, 32, 32)).astype("float32")
+    ts = np.asarray([10.0, 500.0], "float32")
+    names = predictor.get_input_names()
+    assert names == ["latents", "timestep"], names
+    h_lat = predictor.get_input_handle("latents")
+    h_lat.copy_from_cpu(lat)
+    predictor.get_input_handle("timestep").copy_from_cpu(ts)
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    assert out.shape == (2, 4, 32, 32)
+    assert np.isfinite(out.astype("float32")).all()
+
+    # parity vs the eager bf16 model
+    ref = model(paddle.to_tensor(lat.astype("float32")).astype("bfloat16"),
+                paddle.to_tensor(ts))
+    np.testing.assert_allclose(out.astype("float32"),
+                               ref.numpy().astype("float32"),
+                               rtol=5e-2, atol=1e-1)  # bf16 across two
+    # compilation paths (exported vs eager) differs in fusion order
+
+    # dynamic batch: a different batch size without re-export
+    h_lat.copy_from_cpu(rng.normal(size=(1, 4, 32, 32)).astype("float32"))
+    predictor.get_input_handle("timestep").copy_from_cpu(
+        np.asarray([3.0], "float32"))
+    predictor.run()
+    out1 = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    assert out1.shape == (1, 4, 32, 32)
