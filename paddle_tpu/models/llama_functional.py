@@ -188,10 +188,39 @@ def decoder_layer(p, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
 
 def run_layers(stack, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
                sp=False, remat=True):
-    """lax.scan over stacked layer params (leading dim = layers)."""
+    """lax.scan over stacked layer params (leading dim = layers).
+
+    remat: True/'full' (recompute everything — min memory), 'half'
+    (checkpoint every other layer — half the activation memory of no-remat
+    for half the recompute of full, the MFU sweet spot on chips where full
+    no-remat doesn't fit), 'dots' (save matmul outputs, recompute
+    elementwise), or False."""
     body = functools.partial(decoder_layer, args=args, mp_axis=mp_axis,
                              mp_degree=mp_degree, sp=sp)
-    if remat:
+    if remat == "half" and stack_leading_dim(stack) % 2 != 0:
+        import warnings
+
+        warnings.warn("remat='half' needs an even layer count; falling back "
+                      "to full remat")
+        remat = True
+    if remat == "half":
+        ck = jax.checkpoint(body)
+
+        def pair_step(carry, lp2):
+            lp_a = jax.tree.map(lambda a: a[0], lp2)
+            lp_b = jax.tree.map(lambda a: a[1], lp2)
+            h = body(lp_a, carry, cos, sin)   # internals saved
+            h = ck(lp_b, h, cos, sin)         # internals recomputed
+            return h, None
+
+        paired = jax.tree.map(
+            lambda a: a.reshape((a.shape[0] // 2, 2) + a.shape[1:]), stack)
+        h, _ = jax.lax.scan(pair_step, h, paired)
+        return h
+    if remat == "dots":
+        body = jax.checkpoint(
+            body, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif remat:
         body = jax.checkpoint(body)
 
     def step(carry, lp):
@@ -199,6 +228,10 @@ def run_layers(stack, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
 
     h, _ = jax.lax.scan(step, h, stack)
     return h
+
+
+def stack_leading_dim(stack):
+    return jax.tree.leaves(stack)[0].shape[0]
 
 
 def embed_lookup(table, ids, args: LlamaArgs, mp_axis=None, mp_degree=1):
@@ -252,6 +285,43 @@ def parallel_cross_entropy(logits, labels, args: LlamaArgs, mp_axis=None,
 def forward(params, ids, args: LlamaArgs, mp_axis=None, mp_degree=1, sp=False,
             remat=True):
     """Full forward to logits. ids: [b, s] int32."""
+    h = forward_hidden(params, ids, args, mp_axis, mp_degree, sp, remat)
+    return h @ params["lm_head"]
+
+
+def forward_and_loss(params, ids, labels, args: LlamaArgs, mp_axis=None,
+                     mp_degree=1, sp=False, remat=True, loss_chunk=None):
+    """loss_chunk: sequence-chunked final matmul + CE — the [b, s, vocab]
+    logits never materialize at once (peak memory drops by ~s/chunk), at
+    the cost of rematerializing each chunk's vocab matmul in backward.
+    Only the mp_axis=None path supports chunking (the vocab-parallel CE
+    already shards the vocab dim)."""
+    if loss_chunk and mp_axis is None and ids.shape[1] % loss_chunk == 0:
+        h = forward_hidden(params, ids, args, mp_axis, mp_degree, sp, remat)
+        head = params["lm_head"]
+        nchunk = ids.shape[1] // loss_chunk
+        hc = h.reshape(h.shape[0], nchunk, loss_chunk, h.shape[-1])
+        lc = labels.reshape(labels.shape[0], nchunk, loss_chunk)
+        hc = jnp.swapaxes(hc, 0, 1)  # [nchunk, b, chunk, h]
+        lc = jnp.swapaxes(lc, 0, 1)
+
+        @jax.checkpoint
+        def chunk_loss(carry, xs):
+            hcc, lcc = xs
+            logits = hcc @ head
+            loss = parallel_cross_entropy(logits, lcc, args, None, 1)
+            return carry + loss, None
+
+        total, _ = jax.lax.scan(chunk_loss, jnp.zeros((), jnp.float32),
+                                (hc, lc))
+        return total / nchunk
+    logits = forward(params, ids, args, mp_axis, mp_degree, sp, remat)
+    return parallel_cross_entropy(logits, labels, args, mp_axis, mp_degree)
+
+
+def forward_hidden(params, ids, args: LlamaArgs, mp_axis=None, mp_degree=1,
+                   sp=False, remat=True):
+    """Forward up to the final hidden states (pre lm_head)."""
     h = embed_lookup(params["embedding"], ids, args, mp_axis, mp_degree)
     if sp and mp_axis:
         # enter the seq-sharded region (reference ScatterOp,
@@ -261,16 +331,9 @@ def forward(params, ids, args: LlamaArgs, mp_axis=None, mp_degree=1, sp=False,
         h = jax.lax.dynamic_slice_in_dim(h, rank * s_local, s_local, axis=1)
     cos, sin = rope_tables(ids.shape[1], args.hidden_size // args.num_heads,
                            args.rope_theta)
-    h = run_layers(params["layers"], h, cos, sin, args, mp_axis, mp_degree, sp,
-                   remat)
+    h = run_layers(params["layers"], h, cos, sin, args, mp_axis, mp_degree,
+                   sp, remat)
     h = rms_norm(h, params["final_norm"], args.rms_eps)
     if sp and mp_axis:
         h = jax.lax.all_gather(h, mp_axis, axis=1, tiled=True)
-    logits = h @ params["lm_head"]
-    return logits
-
-
-def forward_and_loss(params, ids, labels, args: LlamaArgs, mp_axis=None,
-                     mp_degree=1, sp=False, remat=True):
-    logits = forward(params, ids, args, mp_axis, mp_degree, sp, remat)
-    return parallel_cross_entropy(logits, labels, args, mp_axis, mp_degree)
+    return h
