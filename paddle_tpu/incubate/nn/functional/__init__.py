@@ -20,6 +20,7 @@ __all__ = [
     "fused_multi_head_attention", "fused_feedforward", "fused_linear",
     "fused_bias_dropout_residual_layer_norm", "fused_rms_norm",
     "fused_rotary_position_embedding", "swiglu", "fused_dropout_add",
+    "fused_layer_norm", "masked_multihead_attention", "fused_moe",
 ]
 
 
@@ -190,3 +191,111 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         h = layer_norm(h, h.shape[-1:], weight=ln2_scale, bias=ln2_bias,
                        epsilon=ln2_epsilon)
     return h
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=1, bias=None, residual=None, name=None):
+    """reference fused_layer_norm (norm_helper.h fusion): optional
+    bias+residual add, then LayerNorm over the trailing axes, fp32 stats.
+    Returns (out, residual_out) when residual is given, else out."""
+    def fn(a, w, b2, *extra):
+        off = 0
+        if bias is not None:
+            a = a + extra[off]
+            off += 1
+        res_out = None
+        if residual is not None:
+            a = a + extra[off]
+            res_out = a
+        a32 = a.astype(jnp.float32)
+        axes = tuple(range(begin_norm_axis % a.ndim, a.ndim))
+        mu = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        out = ((a32 - mu) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        # reference convention: weight/bias are 1-D over the FLATTENED
+        # normalized tail; reshape them to broadcast over multiple axes
+        tail = tuple(a.shape[begin_norm_axis % a.ndim:])
+        out = out * w.reshape(tail) + b2.reshape(tail)
+        return (out, res_out) if res_out is not None else out
+
+    args = [x, norm_weight, norm_bias]
+    if bias is not None:
+        args.append(bias)
+    if residual is not None:
+        args.append(residual)
+    return apply(fn, *args, _name="fused_layer_norm")
+
+
+def masked_multihead_attention(x, cache_kv, src_mask=None, seq_len=None,
+                               rotary_embs=None, beam_width=1, name=None):
+    """Single-token decode attention against a KV cache (reference
+    masked_multihead_attention_ kernel used by generation). x: [B, 3*H*D]
+    packed qkv for ONE step; cache_kv: [2, B, H, max_len, D]; seq_len: the
+    current cache length (int); rotary_embs: optional (cos, sin) tables
+    [max_len, D] applied to q/k at position seq_len. Returns
+    (out [B, H*D], new_cache). Dispatches via apply() so autograd/AMP see
+    it like every other fused op."""
+    if beam_width != 1:
+        raise NotImplementedError(
+            "beam_width > 1 (beam-search cache layout) is not supported")
+    t = seq_len if seq_len is not None else 0
+    m = src_mask._data if isinstance(src_mask, Tensor) else src_mask
+    rot = None
+    if rotary_embs is not None:
+        rot = tuple(r._data if isinstance(r, Tensor) else jnp.asarray(r)
+                    for r in rotary_embs)
+
+    def fn(xd, cache):
+        _, b, h, max_len, d = cache.shape
+        q, k, v = jnp.split(xd.reshape(b, 3, h, d), 3, axis=1)
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]  # [B, H, D]
+        if rot is not None:
+            cos, sin = rot[0][t], rot[1][t]  # [D]
+
+            def rope(u):
+                u1, u2 = jnp.split(u.astype(jnp.float32), 2, axis=-1)
+                ur = jnp.concatenate([-u2, u1], axis=-1)
+                return (u.astype(jnp.float32) * cos + ur * sin).astype(u.dtype)
+
+            q, k = rope(q), rope(k)
+        cache = cache.at[0, :, :, t].set(k)
+        cache = cache.at[1, :, :, t].set(v)
+        keys, vals = cache[0], cache[1]  # [B, H, L, D]
+        logits = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
+                            keys.astype(jnp.float32)) / math.sqrt(d)
+        pos_mask = jnp.arange(max_len)[None, None, :] <= t
+        logits = jnp.where(pos_mask, logits, -1e30)
+        if m is not None:
+            logits = logits + m.astype(logits.dtype).reshape(
+                b, 1, -1)[..., :max_len]
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhl,bhld->bhd", probs, vals.astype(jnp.float32))
+        return out.reshape(b, h * d).astype(xd.dtype), cache
+
+    return apply(fn, x, cache_kv, _name="masked_multihead_attention")
+
+
+def fused_moe(x, gate_weight, expert_weights1, expert_weights2, k=2,
+              name=None):
+    """Token-choice MoE in one traced region (reference fused_moe.py):
+    softmax gate -> top-k dispatch -> stacked-expert FFN -> weighted
+    combine. expert_weights1: [E, H, I]; expert_weights2: [E, I, H]."""
+    def fn(a, gw, w1, w2):
+        b = a.reshape(-1, a.shape[-1])  # [T, H]
+        logits = b @ gw  # [T, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        topv, topi = jax.lax.top_k(probs, k)  # [T, k]
+        topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        # dense dispatch: every expert sees every token, combine is masked —
+        # the all-matmul form the MXU likes at moderate E (reference's
+        # scatter path is a GPU memory optimization)
+        hidden = jnp.einsum("th,ehi->tei", b, w1)
+        hidden = jax.nn.gelu(hidden)
+        expert_out = jnp.einsum("tei,eih->teh", hidden, w2)  # [T, E, H]
+        weight = jnp.zeros_like(probs).at[
+            jnp.arange(b.shape[0])[:, None], topi].set(topv)
+        out = jnp.einsum("teh,te->th", expert_out, weight)
+        return out.reshape(a.shape)
+
+    return apply(fn, x, gate_weight, expert_weights1, expert_weights2,
+                 _name="fused_moe")

@@ -43,6 +43,16 @@ def _pick_block(seq, preferred):
     return b
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying `like`'s varying-mesh-axes type, so the
+    kernels compose with shard_map(check_vma=True) (e.g. under the hybrid
+    engine's mp axis or ring attention's cp axis)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -118,8 +128,8 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q=256, block_k=512,
                              seq_k=sk)
     return pl.pallas_call(
         kern,
-        out_shape=(jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-                   jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32)),
+        out_shape=(_sds((b, h, sq, d), q.dtype, q),
+                   _sds((b, h, sq, 1), jnp.float32, q)),
         grid=(b, h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i: (bi, hi, i, 0)),
@@ -249,9 +259,12 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
 
 
 def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q=256,
-               block_k=512, interpret=False):
+               block_k=512, interpret=False, g_lse=None):
     """All operands in [B, H(:k), S, D]; returns (dq, dk, dv) with dk/dv in
-    f32 (caller casts)."""
+    f32 (caller casts). g_lse [B, H, Sq, 1]: cotangent of the logsumexp
+    output (ring attention's merge differentiates through lse); folding it
+    into delta is exact because dlse_i/ds_ij = p_ij, the same softmax
+    weights delta multiplies."""
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     g = h // hk
@@ -261,12 +274,14 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q=256,
     # [B, H, Sq, 1] like lse (TPU-tileable trailing dims)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
                     keepdims=True)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=sq,
                           seq_k=sk),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_shape=_sds((b, h, sq, d), q.dtype, q),
         grid=(b, h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, i: (bi, hi, i, 0)),
@@ -285,8 +300,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q=256,
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=sq,
                           seq_k=sk),
-        out_shape=(jax.ShapeDtypeStruct((b, hk, sk, d), jnp.float32),
-                   jax.ShapeDtypeStruct((b, hk, sk, d), jnp.float32)),
+        out_shape=(_sds((b, hk, sk, d), jnp.float32, q),
+                   _sds((b, hk, sk, d), jnp.float32, q)),
         grid=(b, hk, sk // block_k, g),
         in_specs=[
             pl.BlockSpec((1, 1, sq, d),
@@ -351,6 +366,32 @@ def _fa_bwd(causal, sm_scale, interpret, res, g):
 
 
 _flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_with_lse(q, k, v, causal, sm_scale, interpret=False):
+    """Differentiable (out, lse) pair in paddle layout — the building block
+    ring attention merges across kv shards. lse: [B, H, Sq] f32."""
+    return _fal_fwd(q, k, v, causal, sm_scale, interpret)[0]
+
+
+def _fal_fwd(q, k, v, causal, sm_scale, interpret):
+    qt, kt, vt = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
+    o, lse = _flash_fwd(qt, kt, vt, causal, sm_scale, interpret=interpret)
+    return (_to_bhsd(o), lse[..., 0]), (qt, kt, vt, o, lse)
+
+
+def _fal_bwd(causal, sm_scale, interpret, res, g):
+    qt, kt, vt, o, lse = res
+    g_out, g_lse = g
+    do = _to_bhsd(g_out)
+    dq, dk, dv = _flash_bwd(qt, kt, vt, o, lse, do, causal, sm_scale,
+                            interpret=interpret, g_lse=g_lse[..., None])
+    return (_to_bhsd(dq), _to_bhsd(dk).astype(kt.dtype),
+            _to_bhsd(dv).astype(vt.dtype))
+
+
+flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)
 
 
 # the backward dk/dv kernel streams the full q and dO sequences (plus k/v

@@ -297,3 +297,39 @@ def test_config5_unet_bf16_through_predictor(tmp_path):
     out1 = predictor.get_output_handle(
         predictor.get_output_names()[0]).copy_to_cpu()
     assert out1.shape == (1, 4, 32, 32)
+
+
+def test_incubate_fused_ops():
+    """fused_layer_norm (multi-axis tail + residual), mmha decode loop with
+    RoPE, fused_moe — the incubate fused zoo additions."""
+    import paddle_tpu.incubate.nn.functional as IF
+
+    # multi-axis layer norm with flattened 1-D weight (reference layout)
+    x = paddle.to_tensor(np.random.default_rng(0).normal(
+        size=(2, 3, 4)).astype("float32"))
+    w = paddle.to_tensor(np.ones(12, "float32"))
+    b = paddle.to_tensor(np.zeros(12, "float32"))
+    out = IF.fused_layer_norm(x, w, b, begin_norm_axis=1)
+    flat = out.numpy().reshape(2, -1)
+    np.testing.assert_allclose(flat.mean(1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(flat.std(1), 1.0, atol=1e-2)
+
+    # mmha: greedy 3-step decode with rope; grads flow (apply() dispatch)
+    B, H, D, L = 1, 2, 8, 4
+    cache = paddle.to_tensor(np.zeros((2, B, H, L, D), "float32"))
+    cos = np.ones((L, D), "float32")
+    sin = np.zeros((L, D), "float32")
+    xq = paddle.to_tensor(np.random.default_rng(1).normal(
+        size=(B, 3 * H * D)).astype("float32"))
+    xq.stop_gradient = False
+    o, cache = IF.masked_multihead_attention(
+        xq, cache, seq_len=0, rotary_embs=(paddle.to_tensor(cos),
+                                           paddle.to_tensor(sin)))
+    assert o.shape == [B, H * D]
+    o.sum().backward()
+    assert xq.grad is not None
+
+    import pytest as _pytest
+
+    with _pytest.raises(NotImplementedError):
+        IF.masked_multihead_attention(xq, cache, seq_len=1, beam_width=2)
