@@ -70,7 +70,8 @@ from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401,E402
 # pulling heavy stacks at import time
 _LAZY_SUBMODULES = ("distributed", "inference", "static", "profiler",
                     "incubate", "sparse", "linalg", "fft", "signal",
-                    "geometric", "distribution", "quantization", "text")
+                    "geometric", "distribution", "quantization", "text",
+                    "device")
 
 
 def __getattr__(name):

@@ -65,10 +65,14 @@ def _is_concrete(a):
 
 
 def _sanitize_hook(op_name, arrays):
-    """Installed on the apply() dispatch waist while the checker is on."""
+    """Installed on the apply() dispatch waist while the checker is on.
+    FLAGS_check_nan_inf_level > 0 downgrades abort to log-only (reference
+    check_nan_inf_level semantics)."""
     cfg = _checker_config
     if op_name in cfg.skipped_op_list:
         return
+    level = _flags.get_flags("FLAGS_check_nan_inf_level").get(
+        "FLAGS_check_nan_inf_level") or 0
     for a in arrays:
         if not _is_concrete(a) or not jnp.issubdtype(a.dtype, jnp.floating):
             continue
@@ -77,7 +81,8 @@ def _sanitize_hook(op_name, arrays):
             msg = (f"[check_nan_inf] op '{op_name}' produced {bad} "
                    f"non-finite value(s) in output shape {tuple(a.shape)} "
                    f"dtype {a.dtype}")
-            if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            if (cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
+                    and int(level) == 0):
                 raise FloatingPointError(msg)
             print(msg)
 

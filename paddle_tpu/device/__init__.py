@@ -1,0 +1,24 @@
+"""paddle.device (reference: `python/paddle/device/__init__.py:284`
+set_device — the north-star entry point — plus the cuda/xpu stream, event
+and memory-stat surfaces). Implementations live in framework.device; this
+module is the canonical `paddle.device.*` namespace. `paddle.device.cuda.*`
+routes at the TPU so reference scripts run unchanged."""
+
+from paddle_tpu.framework.device import (  # noqa: F401
+    Event, Stream, cuda, current_stream, device_count, empty_cache,
+    get_all_custom_device_type, get_device, is_compiled_with_cuda,
+    is_compiled_with_custom_device, is_compiled_with_rocm,
+    is_compiled_with_xpu, max_memory_allocated, max_memory_reserved,
+    memory_allocated, memory_reserved, set_device, synchronize,
+)
+
+xpu = cuda  # same compat surface
+
+__all__ = [
+    "Event", "Stream", "cuda", "xpu", "current_stream", "device_count",
+    "empty_cache", "get_all_custom_device_type", "get_device",
+    "is_compiled_with_cuda", "is_compiled_with_custom_device",
+    "is_compiled_with_rocm", "is_compiled_with_xpu",
+    "max_memory_allocated", "max_memory_reserved", "memory_allocated",
+    "memory_reserved", "set_device", "synchronize",
+]

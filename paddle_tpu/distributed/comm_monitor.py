@@ -140,7 +140,14 @@ def start_comm_monitor(store, rank, world_size, **kwargs):
     global _monitor
     if _monitor is not None:
         return _monitor
-    interval = float(os.environ.get("PADDLE_HEARTBEAT_INTERVAL", "1.0"))
+    from paddle_tpu.framework import flags as _flags
+
+    flag = _flags.get_flags("FLAGS_heartbeat_interval_seconds").get(
+        "FLAGS_heartbeat_interval_seconds") or 1.0
+    interval = float(os.environ.get("PADDLE_HEARTBEAT_INTERVAL", flag))
+    timeout = float(_flags.get_flags("FLAGS_distributed_timeout_seconds").get(
+        "FLAGS_distributed_timeout_seconds") or 300.0)
+    kwargs.setdefault("collective_timeout", timeout)
     _monitor = CommMonitor(store, rank, world_size,
                            heartbeat_interval=kwargs.pop(
                                "heartbeat_interval", interval), **kwargs)

@@ -103,3 +103,80 @@ class Stream:
 
 def current_stream(device=None):
     return Stream()
+
+
+# -- memory stats (reference paddle.device.cuda.{max_,}memory_allocated /
+#    phi/core/memory/stats.cc) over PJRT's per-device accounting ------------
+
+def _mem_stats(device=None):
+    """Accepts a jax Device, an int device id, or a 'tpu:0'/'gpu:0' style
+    string (reference paddle.device.cuda API conventions)."""
+    import jax
+
+    if device is None:
+        dev = jax.devices()[0]
+    elif isinstance(device, int):
+        dev = jax.devices()[device]
+    elif isinstance(device, str):
+        idx = int(device.rsplit(":", 1)[1]) if ":" in device else 0
+        dev = jax.devices()[idx]
+    else:
+        dev = device
+    try:
+        return dev.memory_stats() or {}
+    except Exception:  # backends without PJRT memory stats (some CPU paths)
+        return {}
+
+
+def memory_allocated(device=None):
+    """Bytes currently allocated on the device (PJRT bytes_in_use)."""
+    return int(_mem_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    return int(_mem_stats(device).get("peak_bytes_in_use",
+                                      memory_allocated(device)))
+
+
+def memory_reserved(device=None):
+    """Bytes the allocator holds beyond live buffers. PJRT only reports
+    this on backends with a reserving allocator; elsewhere reserved ==
+    allocated (we do NOT report bytes_limit — that is the HBM budget, not
+    a reservation)."""
+    s = _mem_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None):
+    s = _mem_stats(device)
+    return int(s.get("peak_bytes_reserved",
+                     s.get("peak_bytes_in_use", memory_reserved(device))))
+
+
+def empty_cache():
+    """Compat: PJRT frees buffers on release; nothing to flush."""
+
+
+class cuda:
+    """paddle.device.cuda compat namespace routed at the TPU (reference
+    `python/paddle/device/cuda/__init__.py`)."""
+
+    Stream = Stream
+    Event = Event
+    current_stream = staticmethod(current_stream)
+    synchronize = staticmethod(synchronize)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    empty_cache = staticmethod(empty_cache)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def stream_guard(stream):
+        import contextlib
+
+        return contextlib.nullcontext()

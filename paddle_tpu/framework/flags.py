@@ -11,16 +11,71 @@ def define_flag(name, default, help_str=""):
     _flags[name] = default
 
 
-# the subset of reference flags that are meaningful on TPU/XLA
+# the subset of reference flags that are meaningful on TPU/XLA (see
+# `paddle/common/flags.cc` for the full 184-flag registry; flags marked
+# "compat" are accepted + recorded so reference scripts run unchanged, but
+# their GPU-specific effect is subsumed by XLA/PJRT)
+
+# numerics / debugging
 define_flag("FLAGS_check_nan_inf", False, "check every op output for nan/inf")
-define_flag("FLAGS_allocator_strategy", "auto_growth", "host staging allocator strategy")
-define_flag("FLAGS_benchmark", False, "force device sync per op")
-define_flag("FLAGS_use_bf16_matmul", True, "prefer bf16 matmul on MXU")
-define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "gc threshold (no-op: XLA ref-counts)")
+define_flag("FLAGS_check_nan_inf_level", 0, "0=abort on nan/inf, >0=log only")
 define_flag("FLAGS_cudnn_deterministic", False, "deterministic ops")
 define_flag("FLAGS_embedding_deterministic", 0, "deterministic embedding grad")
 define_flag("FLAGS_low_precision_op_list", 0, "amp op list logging")
-define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "compat no-op")
+define_flag("FLAGS_benchmark", False, "force device sync per op")
+define_flag("FLAGS_api_tracer_enabled", False, "record per-op call trace")
+
+# memory (host staging; device memory is PJRT's)
+define_flag("FLAGS_allocator_strategy", "auto_growth", "host staging allocator strategy")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "gc threshold (compat: XLA ref-counts)")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "compat")
+define_flag("FLAGS_initial_gpu_memory_in_mb", 0, "compat")
+define_flag("FLAGS_reallocate_gpu_memory_in_mb", 0, "compat")
+define_flag("FLAGS_gpu_memory_limit_mb", 0, "compat")
+define_flag("FLAGS_use_pinned_memory", True, "compat: PJRT stages host buffers")
+define_flag("FLAGS_fast_eager_deletion_mode", True, "compat")
+define_flag("FLAGS_memory_fraction_of_eager_deletion", 1.0, "compat")
+define_flag("FLAGS_use_stream_safe_cuda_allocator", True, "compat")
+define_flag("FLAGS_allocator_strategy_init_mb", 0, "compat")
+
+# compute / matmul
+define_flag("FLAGS_use_bf16_matmul", True, "prefer bf16 matmul on MXU")
+define_flag("FLAGS_gemm_use_half_precision_compute_type", False,
+            "compat: bf16 accumulation is f32 on MXU")
+define_flag("FLAGS_cublaslt_exhaustive_search_times", 0, "compat: XLA autotunes")
+define_flag("FLAGS_conv_workspace_size_limit", 512, "compat: XLA plans convs")
+define_flag("FLAGS_cudnn_exhaustive_search", False, "compat: XLA autotunes")
+define_flag("FLAGS_enable_cublas_tensor_op_math", True, "compat: MXU is always on")
+define_flag("FLAGS_embedding_fuse", True, "fuse embedding lookups (XLA)")
+
+# execution / scheduling
+define_flag("FLAGS_new_executor_serial_run", False, "compat: XLA schedules")
+define_flag("FLAGS_new_executor_use_local_scope", True, "compat")
+define_flag("FLAGS_use_mkldnn", False, "compat")
+define_flag("FLAGS_inner_op_parallelism", 0, "compat: XLA intra-op parallelism")
+define_flag("FLAGS_max_inplace_grad_add", 0, "compat: donation covers inplace")
+define_flag("FLAGS_sync_nccl_allreduce", False, "compat: collectives are compiled")
+
+# distributed
+define_flag("FLAGS_distributed_timeout_seconds", 300, "store/barrier timeout")
+define_flag("FLAGS_nccl_blocking_wait", False, "compat")
+define_flag("FLAGS_use_stride_kernel", True, "compat: views are XLA slices")
+define_flag("FLAGS_enable_pir_api", True, "compiled path is StableHLO (always)")
+define_flag("FLAGS_enable_auto_parallel", True,
+            "auto-parallel semantics are GSPMD (always)")
+define_flag("FLAGS_heartbeat_interval_seconds", 1.0,
+            "comm-monitor heartbeat period")
+
+# logging / glog compat
+define_flag("FLAGS_v", 0, "verbose logging level (VLOG)")
+define_flag("FLAGS_vmodule", "", "per-module VLOG levels")
+define_flag("FLAGS_logtostderr", True, "log destination")
+define_flag("FLAGS_log_dir", "", "per-rank log directory")
+define_flag("FLAGS_print_ir", False, "dump StableHLO of compiled steps")
+
+# rng
+define_flag("FLAGS_use_curand", False, "compat: TPU PRNG is threefry")
+define_flag("FLAGS_seed", 0, "global seed mirror")
 
 
 def _bootstrap_from_env():

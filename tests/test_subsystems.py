@@ -333,3 +333,42 @@ def test_incubate_fused_ops():
 
     with _pytest.raises(NotImplementedError):
         IF.masked_multihead_attention(xq, cache, seq_len=1, beam_width=2)
+
+
+def test_fused_moe_and_nan_inf_level():
+    import paddle_tpu.incubate.nn.functional as IF
+
+    # fused_moe: output shape, combine weights sum to 1 over chosen experts,
+    # grads flow
+    E, h, i = 4, 8, 16
+    rng = np.random.default_rng(2)
+    x = paddle.to_tensor(rng.normal(size=(2, 3, h)).astype("float32"))
+    x.stop_gradient = False
+    gw = paddle.to_tensor(rng.normal(size=(h, E)).astype("float32"))
+    w1 = paddle.to_tensor(rng.normal(size=(E, h, i)).astype("float32"))
+    w2 = paddle.to_tensor(rng.normal(size=(E, i, h)).astype("float32"))
+    out = IF.fused_moe(x, gw, w1, w2, k=2)
+    assert out.shape == [2, 3, h]
+    out.sum().backward()
+    assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+    # k=1 must equal the single best expert's FFN
+    out1 = IF.fused_moe(x, gw, w1, w2, k=1)
+    logits = x.numpy().reshape(-1, h) @ gw.numpy()
+    best = logits.argmax(-1)
+    flat = x.numpy().reshape(-1, h)
+    import jax.nn as jnn
+    hidden = np.einsum("th,ehi->tei", flat, w1.numpy())
+    hidden = np.asarray(jnn.gelu(jnp.asarray(hidden)))
+    eo = np.einsum("tei,eih->teh", hidden, w2.numpy())
+    manual = eo[np.arange(flat.shape[0]), best]
+    np.testing.assert_allclose(out1.numpy().reshape(-1, h), manual,
+                               rtol=1e-4, atol=1e-5)
+
+    # FLAGS_check_nan_inf_level > 0: log-only instead of abort
+    paddle.set_flags({"FLAGS_check_nan_inf": True,
+                      "FLAGS_check_nan_inf_level": 1})
+    try:
+        paddle.log(paddle.to_tensor(np.array([-1.0], "float32")))  # no raise
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False,
+                          "FLAGS_check_nan_inf_level": 0})
