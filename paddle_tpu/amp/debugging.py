@@ -64,6 +64,9 @@ def _is_concrete(a):
         isinstance(a, jax.Array) and not isinstance(a, jax.core.Tracer))
 
 
+_nan_inf_level = 0  # cached via watch_flag: the hook runs on the hot path
+
+
 def _sanitize_hook(op_name, arrays):
     """Installed on the apply() dispatch waist while the checker is on.
     FLAGS_check_nan_inf_level > 0 downgrades abort to log-only (reference
@@ -71,8 +74,7 @@ def _sanitize_hook(op_name, arrays):
     cfg = _checker_config
     if op_name in cfg.skipped_op_list:
         return
-    level = _flags.get_flags("FLAGS_check_nan_inf_level").get(
-        "FLAGS_check_nan_inf_level") or 0
+    level = _nan_inf_level
     for a in arrays:
         if not _is_concrete(a) or not jnp.issubdtype(a.dtype, jnp.floating):
             continue
@@ -127,10 +129,18 @@ def disable_tensor_checker():
     _flags.set_flags({"FLAGS_check_nan_inf": False})
 
 
+def _set_level(v):
+    global _nan_inf_level
+    _nan_inf_level = int(v or 0)
+
+
 # flags.set_flags drives the hook, so FLAGS_check_nan_inf works however it
 # is set (env bootstrap, paddle.set_flags, or the functions above)
 _flags.watch_flag("FLAGS_check_nan_inf", lambda v: _sync_from_flag())
+_flags.watch_flag("FLAGS_check_nan_inf_level", _set_level)
 _sync_from_flag()
+_set_level(_flags.get_flags("FLAGS_check_nan_inf_level")[
+    "FLAGS_check_nan_inf_level"])
 
 
 def check_numerics(x, op_type="", var_name="",

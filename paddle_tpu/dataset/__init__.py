@@ -1,0 +1,109 @@
+"""paddle.dataset: legacy reader-creator API (reference:
+`python/paddle/dataset/` — mnist/cifar/uci_housing/imdb downloaders that
+return `reader()` generators consumed by the old training loops).
+
+TPU build: the environment has no egress, so downloaders are backed by the
+framework's deterministic synthetic datasets (paddle.vision.datasets) —
+same reader-creator protocol (`train()`/`test()` return a zero-arg callable
+yielding samples), so legacy scripts run unchanged on synthetic data. Real
+files are used when the caller passes explicit paths to the vision
+datasets directly.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["mnist", "cifar", "uci_housing", "common"]
+
+
+class _ReaderModule:
+    def __init__(self, make_train, make_test):
+        self._train = make_train
+        self._test = make_test
+
+    def train(self):
+        return self._train
+
+    def test(self):
+        return self._test
+
+
+def _mnist_reader(mode):
+    def reader():
+        from paddle_tpu.vision.datasets import MNIST
+
+        ds = MNIST(mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield img.reshape(-1).astype("float32"), int(label[0])
+
+    return reader
+
+
+mnist = _ReaderModule(_mnist_reader("train"), _mnist_reader("test"))
+
+
+def _cifar_reader(mode):
+    def reader():
+        from paddle_tpu.vision.datasets import Cifar10
+
+        ds = Cifar10(mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            yield (np.asarray(img, "float32").reshape(-1),
+                   int(np.asarray(label).ravel()[0]))
+
+    return reader
+
+
+cifar = _ReaderModule(_cifar_reader("train"), _cifar_reader("test"))
+
+
+def _housing_reader(mode):
+    def reader():
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 404 if mode == "train" else 102
+        x = rng.rand(n, 13).astype("float32")
+        w = rng.rand(13).astype("float32")
+        y = (x @ w + 0.1 * rng.randn(n)).astype("float32")
+        for i in range(n):
+            yield x[i], y[i:i + 1]
+
+    return reader
+
+
+uci_housing = _ReaderModule(_housing_reader("train"), _housing_reader("test"))
+
+
+class common:
+    """reference dataset/common.py helpers."""
+
+    @staticmethod
+    def shuffle(reader, buf_size):
+        def shuffled():
+            buf = []
+            for item in reader():
+                buf.append(item)
+                if len(buf) >= buf_size:
+                    np.random.shuffle(buf)
+                    yield from buf
+                    buf = []
+            np.random.shuffle(buf)
+            yield from buf
+
+        return shuffled
+
+    @staticmethod
+    def batch(reader, batch_size, drop_last=False):
+        def batched():
+            batch = []
+            for item in reader():
+                batch.append(item)
+                if len(batch) == batch_size:
+                    yield batch
+                    batch = []
+            if batch and not drop_last:
+                yield batch
+
+        return batched

@@ -42,7 +42,7 @@ from paddle_tpu.distributed.ring_attention import ring_attention  # noqa: F401
 import importlib as _importlib
 
 _LAZY_SUBMODULES = ("fleet", "checkpoint", "launch", "sharding", "utils",
-                    "auto_parallel", "rpc")
+                    "auto_parallel", "rpc", "ps")
 
 
 def __getattr__(name):

@@ -167,7 +167,8 @@ class ElasticSupervisor:
     failure or scale events up to max_restarts."""
 
     def __init__(self, cmd, env=None, env_fn=None, max_restarts=3,
-                 manager=None, poll_interval=0.5, log=print):
+                 manager=None, poll_interval=0.5, log=print, log_dir=None,
+                 rank=0):
         self.cmd = cmd
         self.env = env
         # env_fn(manager) -> env dict, evaluated at EVERY (re)spawn so a
@@ -179,9 +180,21 @@ class ElasticSupervisor:
         self.poll_interval = poll_interval
         self.restarts = 0
         self.log = log
+        self.log_dir = log_dir
+        self.rank = rank
 
     def _spawn(self):
         env = self.env_fn(self.manager) if self.env_fn is not None else self.env
+        if self.log_dir:
+            # per-rank log files (reference launch/job/container.py): each
+            # attempt appends, stdout+stderr interleaved
+            os.makedirs(self.log_dir, exist_ok=True)
+            logf = open(os.path.join(
+                self.log_dir, f"rank_{self.rank}.log"), "ab")
+            logf.write(f"\n===== attempt {self.restarts} =====\n".encode())
+            logf.flush()
+            return subprocess.Popen(self.cmd, env=env, stdout=logf,
+                                    stderr=subprocess.STDOUT)
         return subprocess.Popen(self.cmd, env=env)
 
     def run(self):

@@ -87,7 +87,7 @@ def launch(argv=None):
         sup = ElasticSupervisor(
             [sys.executable, args.script] + list(args.script_args),
             env_fn=child_env, max_restarts=args.max_restarts,
-            manager=manager)
+            manager=manager, log_dir=args.log_dir, rank=args.rank)
         raise SystemExit(sup.run())
 
     if args.nnodes > 1:

@@ -1,0 +1,173 @@
+"""Parameter-server training primitives (reference:
+`paddle/fluid/distributed/ps/` service+table C++ stack and
+`python/paddle/distributed/ps/` — sparse-recommendation training where
+huge embedding tables live on server ranks and trainers pull/push rows).
+
+TPU-native scope: the reference's brpc service + table zoo exists for
+CPU-cluster recommendation models; on this stack the *protocol* is what
+matters for capability parity. Tables are numpy-backed on the server
+(sparse rows materialize on demand), transport is the framework's
+`distributed.rpc` (TCPStore-rendezvoused TCP), and trainers embed pulled
+rows into device computations. Dense training should use the collective
+path (fleet/Engine) — this module is for the sparse pull/push pattern.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = ["SparseTable", "init_server", "shutdown_server", "pull_sparse",
+           "push_sparse", "pull_dense", "push_dense", "get_table"]
+
+
+class SparseTable:
+    """Row-sharded embedding table with lazy row creation and SGD push
+    (reference `ps/table/memory_sparse_table.cc` semantics)."""
+
+    def __init__(self, dim, initializer="uniform", init_scale=0.01, lr=0.05,
+                 seed=0):
+        self.dim = dim
+        self.lr = lr
+        self.init_scale = init_scale
+        self.initializer = initializer
+        self._rows = {}
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    def _row(self, key):
+        r = self._rows.get(int(key))
+        if r is None:
+            if self.initializer == "zeros":
+                r = np.zeros(self.dim, np.float32)
+            else:
+                r = self._rng.uniform(-self.init_scale, self.init_scale,
+                                      self.dim).astype(np.float32)
+            self._rows[int(key)] = r
+        return r
+
+    def pull(self, ids):
+        keys = np.asarray(ids).ravel()
+        if keys.size == 0:  # empty feature batch: valid in sparse workloads
+            return np.zeros((0, self.dim), np.float32)
+        with self._lock:
+            return np.stack([self._row(k) for k in keys])
+
+    def push(self, ids, grads, lr=None):
+        lr = lr if lr is not None else self.lr
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            for k, g in zip(np.asarray(ids).ravel(), grads):
+                self._rows[int(k)] = self._row(k) - lr * g
+
+    def size(self):
+        return len(self._rows)
+
+
+class DenseTable:
+    def __init__(self, shape, lr=0.05, seed=0):
+        self.value = np.random.RandomState(seed).uniform(
+            -0.01, 0.01, shape).astype(np.float32)
+        self.lr = lr
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.value.copy()
+
+    def push(self, grad, lr=None):
+        with self._lock:
+            self.value -= (lr if lr is not None else self.lr) * np.asarray(
+                grad, np.float32)
+
+
+_tables = {}
+_server_worker = None  # rpc worker name hosting the tables; None = local
+
+
+# -- server-side functions (invoked via rpc on the server rank) -------------
+
+def _srv_create(name, kind, **kwargs):
+    _tables[name] = (SparseTable(**kwargs) if kind == "sparse"
+                     else DenseTable(**kwargs))
+    return True
+
+
+def _srv_pull_sparse(name, ids):
+    return _tables[name].pull(ids)
+
+
+def _srv_push_sparse(name, ids, grads, lr=None):
+    _tables[name].push(ids, grads, lr)
+    return True
+
+
+def _srv_pull_dense(name):
+    return _tables[name].pull()
+
+
+def _srv_push_dense(name, grad, lr=None):
+    _tables[name].push(grad, lr)
+    return True
+
+
+def _srv_shutdown():
+    _tables.clear()
+    return True
+
+
+def _call(fn, *args, **kwargs):
+    if _server_worker is None:
+        return fn(*args, **kwargs)
+    from paddle_tpu.distributed import rpc
+
+    return rpc.rpc_sync(_server_worker, fn, args=args, kwargs=kwargs)
+
+
+# -- public API --------------------------------------------------------------
+
+def init_server(tables, server_worker=None):
+    """tables: {name: {"kind": "sparse"|"dense", ...SparseTable/DenseTable
+    kwargs}}. With server_worker set (an rpc worker name from init_rpc),
+    tables are created THERE and all pulls/pushes route over rpc; without
+    it, tables are process-local (single-machine mode)."""
+    global _server_worker
+    _server_worker = server_worker
+    for name, cfg in tables.items():
+        cfg = dict(cfg)
+        kind = cfg.pop("kind", "sparse")
+        _call(_srv_create, name, kind, **cfg)
+
+
+def shutdown_server():
+    """Clears the tables WHERE THEY LIVE (over rpc in server mode), then
+    detaches — server-side GBs of rows must not outlive the job."""
+    global _server_worker
+    _call(_srv_shutdown)
+    _tables.clear()
+    _server_worker = None
+
+
+def get_table(name):
+    """Local-mode table handle (server mode: use pull/push)."""
+    return _tables.get(name)
+
+
+def pull_sparse(name, ids):
+    """Fetch embedding rows for ids -> np.ndarray [len(ids), dim]."""
+    return _call(_srv_pull_sparse, name, np.asarray(ids))
+
+
+def push_sparse(name, ids, grads, lr=None):
+    """Apply SGD on the server rows: row[k] -= lr * grad."""
+    return _call(_srv_push_sparse, name, np.asarray(ids),
+                 np.asarray(grads, np.float32), lr)
+
+
+def pull_dense(name):
+    return _call(_srv_pull_dense, name)
+
+
+def push_dense(name, grad, lr=None):
+    return _call(_srv_push_dense, name, np.asarray(grad, np.float32), lr)

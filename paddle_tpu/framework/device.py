@@ -116,10 +116,9 @@ def _mem_stats(device=None):
     if device is None:
         dev = jax.devices()[0]
     elif isinstance(device, int):
-        dev = jax.devices()[device]
+        dev = jax.devices()[min(device, len(jax.devices()) - 1)]
     elif isinstance(device, str):
-        idx = int(device.rsplit(":", 1)[1]) if ":" in device else 0
-        dev = jax.devices()[idx]
+        dev = _resolve_device(device)  # canonical platform + index handling
     else:
         dev = device
     try:
@@ -134,8 +133,8 @@ def memory_allocated(device=None):
 
 
 def max_memory_allocated(device=None):
-    return int(_mem_stats(device).get("peak_bytes_in_use",
-                                      memory_allocated(device)))
+    s = _mem_stats(device)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
 
 
 def memory_reserved(device=None):
@@ -150,7 +149,7 @@ def memory_reserved(device=None):
 def max_memory_reserved(device=None):
     s = _mem_stats(device)
     return int(s.get("peak_bytes_reserved",
-                     s.get("peak_bytes_in_use", memory_reserved(device))))
+                     s.get("peak_bytes_in_use", s.get("bytes_in_use", 0))))
 
 
 def empty_cache():

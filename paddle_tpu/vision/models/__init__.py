@@ -4,3 +4,14 @@ from paddle_tpu.vision.models.resnet import (  # noqa: F401
 )
 from paddle_tpu.vision.models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from paddle_tpu.vision.models.mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from paddle_tpu.vision.models.alexnet import AlexNet, alexnet  # noqa: F401
+from paddle_tpu.vision.models.squeezenet import (  # noqa: F401
+    SqueezeNet, squeezenet1_0, squeezenet1_1,
+)
+from paddle_tpu.vision.models.densenet import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+)
+from paddle_tpu.vision.models.shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+)

@@ -224,3 +224,21 @@ def test_pad():
     y = F.pad(x, [1, 1, 1, 1])
     assert y.shape == [1, 1, 4, 4]
     assert y.numpy()[0, 0, 0, 0] == 0
+
+
+def test_vision_model_zoo_forward_backward():
+    """Every zoo architecture runs forward + backward at a small input
+    (reference vision/models test style)."""
+    from paddle_tpu.vision import models as M
+
+    zoo = [
+        M.alexnet(num_classes=10),
+        M.squeezenet1_1(num_classes=10),
+        M.densenet121(num_classes=10),
+        M.shufflenet_v2_x0_25(num_classes=10),
+    ]
+    x = paddle.randn([2, 3, 64, 64])
+    for m in zoo:
+        out = m(x)
+        assert out.shape == [2, 10], type(m).__name__
+        out.sum().backward()

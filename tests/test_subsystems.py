@@ -372,3 +372,50 @@ def test_fused_moe_and_nan_inf_level():
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False,
                           "FLAGS_check_nan_inf_level": 0})
+
+
+def test_parameter_server_sparse_training():
+    """PS pull/push protocol: local mode trains a toy sparse-embedding
+    regression; rpc mode routes the same ops through a worker agent
+    (reference distributed/ps pull_sparse/push_sparse pattern)."""
+    from paddle_tpu.distributed import ps
+
+    ps.init_server({"emb": {"kind": "sparse", "dim": 4, "lr": 0.5},
+                    "w": {"kind": "dense", "shape": (4,), "lr": 0.5}})
+    try:
+        ids = np.array([3, 7, 3], "int64")
+        rows = ps.pull_sparse("emb", ids)
+        assert rows.shape == (3, 4)
+        np.testing.assert_allclose(rows[0], rows[2])  # same key, same row
+
+        # a few SGD steps on rows toward a target: loss must drop
+        target = np.ones((3, 4), "float32")
+        losses = []
+        for _ in range(20):
+            rows = ps.pull_sparse("emb", ids)
+            losses.append(float(((rows - target) ** 2).mean()))
+            ps.push_sparse("emb", ids, 2 * (rows - target) / rows.size)
+        assert losses[-1] < losses[0] * 0.1
+
+        d0 = ps.pull_dense("w")
+        ps.push_dense("w", np.ones(4, "float32"))
+        np.testing.assert_allclose(ps.pull_dense("w"), d0 - 0.5)
+    finally:
+        ps.shutdown_server()
+
+    # rpc-routed mode against our own agent
+    from paddle_tpu.distributed import rpc
+
+    rpc.init_rpc("ps_server", rank=0, world_size=1)
+    try:
+        ps.init_server({"emb": {"kind": "sparse", "dim": 2}},
+                       server_worker="ps_server")
+        rows = ps.pull_sparse("emb", np.array([1, 2], "int64"))
+        assert rows.shape == (2, 2)
+        ps.push_sparse("emb", np.array([1], "int64"),
+                       np.ones((1, 2), "float32"), lr=1.0)
+        rows2 = ps.pull_sparse("emb", np.array([1], "int64"))
+        np.testing.assert_allclose(rows2[0], rows[0] - 1.0)
+    finally:
+        ps.shutdown_server()
+        rpc.shutdown()
