@@ -19,10 +19,16 @@ is a single `shard_map`-partitioned XLA program over a
          overlaps the ppermute with the next micro-batch's compute.
   - DP:  batch sharded over 'dp'; gradient `pmean` over 'dp' (the
          reference's EagerReducer fused allreduce, reducer.cc:1089).
-  - ZeRO-1: AdamW moments sharded over 'dp' via NamedSharding on the
+  - ZeRO-1/2: AdamW moments sharded over 'dp' via NamedSharding on the
          optimizer update (optimizer-state partition of
          group_sharded_optimizer_stage2.py:53); XLA inserts the
          reduce-scatter/all-gather pair.
+  - ZeRO-3 (zero_stage=3): layer params live dp-SHARDED; each scan step
+         all-gathers just its layer's weights right before use (the
+         stage-3 pre-forward hook, group_sharded_stage3.py:85,560) and the
+         gather's AD transpose reduce-scatters grads to their owner
+         shards — no hand-written reducer, parity-tested against
+         single-device autodiff.
 """
 
 from __future__ import annotations
@@ -93,7 +99,7 @@ class HybridParallelEngine:
 
     def __init__(self, config, dp=1, pp=1, mp=1, micro_batches=None, sp=False,
                  devices=None, dtype=jnp.float32, remat=True, lr=3e-4,
-                 schedule="gpipe", num_virtual_stages=2):
+                 schedule="gpipe", num_virtual_stages=2, zero_stage=1):
         from paddle_tpu.models.llama import LlamaConfig  # noqa: F401 (type)
 
         self.config = config
@@ -104,6 +110,27 @@ class HybridParallelEngine:
         self.dtype = dtype
         self.remat = remat
         self.lr = lr
+        # ZeRO: stage 1/2 = dp-sharded AdamW moments (in ONE compiled step
+        # the stage-1/2 distinction collapses — XLA frees grads inside the
+        # program); stage 3 additionally shards the LAYER params over 'dp':
+        # each scan step all-gathers its layer pre-use and the AD transpose
+        # reduce-scatters the grads (reference group_sharded_stage3.py:85;
+        # embedding/head/final_norm stay moment-sharded only)
+        if zero_stage not in (1, 2, 3):
+            raise ValueError("zero_stage must be 1, 2, or 3")
+        self.zero_stage = zero_stage
+        self._zero3 = zero_stage >= 3 and dp > 1
+        self._zero_axis = "dp" if self._zero3 else None
+        if self._zero3:
+            h, hd = config.hidden_size, config.hidden_size // config.num_attention_heads
+            i = config.intermediate_size
+            nh = config.num_attention_heads
+            if h % dp or i % (mp * dp) or (nh * hd) % (mp * dp):
+                raise ValueError(
+                    "zero_stage=3 shards the first param axis over dp "
+                    f"(composed with mp): hidden_size {h} % dp, "
+                    f"intermediate {i} % (mp*dp) and heads*head_dim "
+                    f"{nh * hd} % (mp*dp) must all be 0")
         if schedule not in ("gpipe", "1f1b", "interleave"):
             raise ValueError(f"unknown pipeline schedule {schedule!r} "
                              "(gpipe | 1f1b | interleave)")
@@ -157,6 +184,17 @@ class HybridParallelEngine:
         if self.mp == 1:
             layer_specs = {k: P("pp", *([None] * (len(v) - 1)))
                            for k, v in layer_specs.items()}
+        if self._zero3:
+            # stage 3: shard the first PARAM axis (post-stack axis 0) over
+            # 'dp' — composed with 'mp' when that axis is already
+            # tensor-parallel ('mp' outer, 'dp' inner, so the tiled dp
+            # all_gather reassembles each mp block contiguously)
+            def z3(spec):
+                parts = list(spec)
+                parts[1] = ("mp", "dp") if parts[1] == "mp" else "dp"
+                return P(*parts)
+
+            layer_specs = {k: z3(v) for k, v in layer_specs.items()}
         emb = P("mp", None) if self.mp > 1 else P(None, None)
         head = P(None, "mp") if self.mp > 1 else P(None, None)
         return {
@@ -168,8 +206,15 @@ class HybridParallelEngine:
 
     def _zero_spec(self, spec, shape):
         """ZeRO-1: additionally shard optimizer moments over 'dp' along the
-        first free, divisible axis (group_sharded_optimizer_stage2.py:53)."""
+        first free, divisible axis (group_sharded_optimizer_stage2.py:53).
+        Stage-3 leaves already carry 'dp' in the param spec — moments
+        inherit it."""
         if self.dp == 1:
+            return spec
+        present = set()
+        for p in spec:
+            present.update(p if isinstance(p, tuple) else (p,))
+        if "dp" in present:
             return spec
         parts = list(spec)
         for i, (p, d) in enumerate(zip(parts, shape)):
@@ -301,9 +346,11 @@ class HybridParallelEngine:
         embed_mb, head_loss, zero_loss = self._mk_stage_helpers(
             ids, labels, s_len)
 
+        za = self._zero_axis
+
         def stage_fn(h):
             return lf.run_layers(lp["layers"], h, cos, sin, args, mp_axis, mp,
-                                 sp, self.remat)
+                                 sp, self.remat, zero_axis=za)
 
         perm = [(i, i + 1) for i in range(S - 1)]
 
@@ -378,12 +425,14 @@ class HybridParallelEngine:
         for k in ("embedding", "lm_head", "final_norm"):
             lp[k] = jax.lax.pcast(lp[k], ("pp",), to="varying")
 
+        za = self._zero_axis
+
         def chunk_fn(v_idx, h):
             chunk = jax.tree.map(
                 lambda a: jax.lax.dynamic_slice_in_dim(a, v_idx * lc, lc, 0),
                 lp["layers"])
             return lf.run_layers(chunk, h, cos, sin, args, mp_axis, mp, sp,
-                                 self.remat)
+                                 self.remat, zero_axis=za)
 
         embed_mb, head_loss, zero_loss = self._mk_stage_helpers(
             ids, labels, s_len)
@@ -471,9 +520,11 @@ class HybridParallelEngine:
                                          to="varying"),
             lp, spec_tree, is_leaf=lambda x: isinstance(x, P))
 
+        za = self._zero_axis
+
         def stage_layers(lp_, h):
             return lf.run_layers(lp_["layers"], h, cos, sin, args, mp_axis,
-                                 mp, sp, self.remat)
+                                 mp, sp, self.remat, zero_axis=za)
 
         embed_mb, head_loss, zero_loss = self._mk_stage_helpers(
             ids, labels, s_len)

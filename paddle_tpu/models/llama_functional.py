@@ -187,16 +187,30 @@ def decoder_layer(p, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
 
 
 def run_layers(stack, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
-               sp=False, remat=True):
+               sp=False, remat=True, zero_axis=None):
     """lax.scan over stacked layer params (leading dim = layers).
 
     remat: True/'full' (recompute everything — min memory), 'half'
     (checkpoint every other layer — half the activation memory of no-remat
     for half the recompute of full, the MFU sweet spot on chips where full
     no-remat doesn't fit), 'dots' (save matmul outputs, recompute
-    elementwise), or False."""
-    body = functools.partial(decoder_layer, args=args, mp_axis=mp_axis,
-                             mp_degree=mp_degree, sp=sp)
+    elementwise), or False.
+
+    zero_axis: ZeRO-3 (reference group_sharded_stage3.py:85): layer params
+    arrive SHARDED over this mesh axis; each scan step all-gathers just its
+    layer's weights right before use (the stage-3 pre-forward hook) and the
+    gather's AD transpose is psum_scatter — grads leave reduce-scattered to
+    their owner shards with no hand-written reducer."""
+    base_body = functools.partial(decoder_layer, args=args, mp_axis=mp_axis,
+                                  mp_degree=mp_degree, sp=sp)
+    if zero_axis is None:
+        body = base_body
+    else:
+        def body(lp, h, cos, sin):
+            full = jax.tree.map(
+                lambda a: jax.lax.all_gather(a, zero_axis, axis=0,
+                                             tiled=True), lp)
+            return base_body(full, h, cos, sin)
     if remat == "half" and stack_leading_dim(stack) % 2 != 0:
         import warnings
 
