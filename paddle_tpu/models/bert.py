@@ -1,0 +1,124 @@
+"""BERT model family (config 3 of BASELINE: BERT-base MLM under fleet
+sharding stage-2; reference model served through PaddleNLP on the reference
+stack — here a first-class in-repo family like Llama).
+
+Built from the framework's own nn layers so it trains through every path:
+eager, `paddle.Model`, and the compiled distributed `Engine` (which is how
+config 3 runs: `Engine(BertForPretraining(cfg), loss=BertPretrainingLoss(),
+optimizer=..., dp=..., sharding_stage=2)`).
+"""
+
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertPretrainingLoss", "bert_base", "bert_tiny"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=512,
+                 type_vocab_size=2, hidden_dropout_prob=0.1,
+                 layer_norm_eps=1e-12):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = paddle.arange(s, dtype="int32")
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            d_model=cfg.hidden_size, nhead=cfg.num_attention_heads,
+            dim_feedforward=cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation="gelu")
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.pooler_act = nn.Tanh()
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h = self.embeddings(input_ids, token_type_ids)
+        h = self.encoder(h, src_mask=attention_mask)
+        pooled = self.pooler_act(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (the config-3 pretraining objective)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_act = nn.GELU()
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.mlm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+        self.nsp_head = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        h, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        mlm = self.mlm_head(self.mlm_norm(self.mlm_act(
+            self.mlm_transform(h))))
+        nsp = self.nsp_head(pooled)
+        return mlm, nsp
+
+
+class BertPretrainingLoss(nn.Layer):
+    """MLM CE over masked positions (-100 = ignore) + NSP CE."""
+
+    def forward(self, outputs, mlm_labels, nsp_labels=None):
+        mlm_logits, nsp_logits = outputs
+        vocab = mlm_logits.shape[-1]
+        loss = nn.functional.cross_entropy(
+            paddle.reshape(mlm_logits, [-1, vocab]),
+            paddle.reshape(mlm_labels, [-1]), ignore_index=-100)
+        if nsp_labels is not None:
+            loss = loss + nn.functional.cross_entropy(
+                nsp_logits, paddle.reshape(nsp_labels, [-1]))
+        return loss
+
+
+def bert_base(**kwargs):
+    return BertForPretraining(BertConfig(**kwargs))
+
+
+def bert_tiny(**kwargs):
+    cfg = dict(vocab_size=1024, hidden_size=64, num_hidden_layers=2,
+               num_attention_heads=4, intermediate_size=128,
+               max_position_embeddings=128, hidden_dropout_prob=0.0)
+    cfg.update(kwargs)
+    return BertForPretraining(BertConfig(**cfg))
