@@ -71,7 +71,7 @@ from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401,E402
 _LAZY_SUBMODULES = ("distributed", "inference", "static", "profiler",
                     "incubate", "sparse", "linalg", "fft", "signal",
                     "geometric", "distribution", "quantization", "text",
-                    "device", "dataset")
+                    "device", "dataset", "audio")
 
 
 def __getattr__(name):

@@ -1,0 +1,16 @@
+"""paddle.audio (reference: `python/paddle/audio/` — mel/fbank functional
+utilities + Spectrogram/MelSpectrogram/LogMelSpectrogram/MFCC feature
+layers over the signal stack).
+
+TPU-native: everything is jnp over the framework's `signal.stft` /
+`fft` modules, so feature extraction jits and fuses into the model's first
+conv. Backends (soundfile IO) are host-side; the zero-egress environment
+ships no codecs, so `load` accepts wav via the stdlib `wave` module only.
+"""
+
+from paddle_tpu.audio import backends  # noqa: F401
+from paddle_tpu.audio import functional  # noqa: F401
+from paddle_tpu.audio import features  # noqa: F401
+from paddle_tpu.audio.backends import load, save, info  # noqa: F401
+
+__all__ = ["functional", "features", "backends", "load", "save", "info"]

@@ -1,0 +1,71 @@
+"""Audio IO backends (reference: `python/paddle/audio/backends/` over
+soundfile). Zero-egress image ships no codecs, so this backend speaks WAV
+only, via the stdlib `wave` module — 16/32-bit PCM in, float32 [-1, 1]
+tensors out."""
+
+from __future__ import annotations
+
+import wave
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["load", "save", "info", "list_available_backends",
+           "get_current_backend"]
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return "wave"
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """-> (waveform Tensor [channels, frames] (channels_first) or
+    [frames, channels], sample_rate)."""
+    with wave.open(str(filepath), "rb") as w:
+        sr = w.getframerate()
+        n_channels = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(frame_offset)
+        n = num_frames if num_frames > 0 else w.getnframes() - frame_offset
+        raw = w.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}.get(width)
+    if dtype is None:
+        raise ValueError(f"unsupported PCM sample width {width}")
+    data = np.frombuffer(raw, dtype).reshape(-1, n_channels)
+    if normalize:
+        if width == 1:  # unsigned 8-bit
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    out = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(out)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         bits_per_sample=16):
+    data = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+    if channels_first:
+        data = data.T  # -> [frames, channels]
+    if bits_per_sample != 16:
+        raise ValueError("only 16-bit PCM save is supported")
+    pcm = np.clip(data, -1.0, 1.0)
+    pcm = (pcm * 32767.0).astype(np.int16)
+    with wave.open(str(filepath), "wb") as w:
+        w.setnchannels(pcm.shape[1] if pcm.ndim > 1 else 1)
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(np.ascontiguousarray(pcm).tobytes())
+
+
+def info(filepath):
+    with wave.open(str(filepath), "rb") as w:
+        return {"sample_rate": w.getframerate(),
+                "num_frames": w.getnframes(),
+                "num_channels": w.getnchannels(),
+                "bits_per_sample": 8 * w.getsampwidth()}

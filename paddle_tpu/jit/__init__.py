@@ -99,6 +99,7 @@ class StaticFunction:
         self._layer = function if isinstance(function, Layer) else None
         self._jitted = None
         self._state = None
+        self._eager_only = False
 
     def _build(self):
         if self._layer is not None:
@@ -125,21 +126,45 @@ class StaticFunction:
             self._pure_fn = pure_fn
 
     def __call__(self, *args, **kwargs):
+        if self._eager_only:
+            return self._fn(*args, **kwargs)
         if self._jitted is None:
             self._build()
         key = _rng.next_key()
         arg_datas = _tree_to_data(args)
         kwarg_datas = _tree_to_data(kwargs)
-        if self._layer is not None:
-            state = _SwappedState(self._layer)
-            params = {k: p._data for k, p in state.params.items()}
-            buffers = {k: b._data for k, b in state.buffers.items()}
-            out, new_buffers = self._jitted(params, buffers, key, *arg_datas, **kwarg_datas)
-            for k, b in state.buffers.items():
-                b._data = new_buffers[k]
+        try:
+            if self._layer is not None:
+                state = _SwappedState(self._layer)
+                params = {k: p._data for k, p in state.params.items()}
+                buffers = {k: b._data for k, b in state.buffers.items()}
+                out, new_buffers = self._jitted(params, buffers, key,
+                                                *arg_datas, **kwarg_datas)
+                for k, b in state.buffers.items():
+                    b._data = new_buffers[k]
+                return _tree_to_tensor(out)
+            out = self._jitted(key, *arg_datas, **kwarg_datas)
             return _tree_to_tensor(out)
-        out = self._jitted(key, *arg_datas, **kwarg_datas)
-        return _tree_to_tensor(out)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.TracerArrayConversionError):
+            # tensor-dependent Python control flow can't trace (the
+            # reference's SOT falls back to eager sub-graphs here,
+            # jit/sot/translate.py); degrade the WHOLE callable to eager
+            # with a one-time warning instead of crashing user code
+            import warnings
+
+            name = getattr(self._fn, "__name__",
+                           type(self._fn).__name__)
+            # per-callable warning: EVERY degraded function must announce
+            # itself (a global once-flag would silence later fallbacks)
+            warnings.warn(
+                f"to_static({name}): tensor-dependent Python control flow "
+                "cannot be traced; this callable now runs eagerly. Rewrite "
+                "with paddle.where / lax-style control flow to compile.")
+            self._eager_only = True
+            return self._fn(*args, **kwargs)
 
     # reference-compat introspection
     @property

@@ -4,6 +4,8 @@ Reference parity targets:
   - Llama decoder family (reference:
     `test/auto_parallel/hybrid_strategy/semi_auto_parallel_llama_model.py`,
     the hybrid-parallel Llama used by the north-star config 4).
+  - BERT encoder family (config 3: BERT-base MLM under sharding stage-2).
+  - Diffusion UNet (config 5: Predictor inference).
   - Vision models live in `paddle_tpu.vision.models`.
 """
 
@@ -19,3 +21,8 @@ from paddle_tpu.models.llama import (  # noqa: F401
     LlamaPretrainingCriterion,
 )
 from paddle_tpu.models import llama_functional  # noqa: F401
+from paddle_tpu.models.bert import (  # noqa: F401
+    BertConfig, BertForPretraining, BertModel, BertPretrainingLoss,
+    bert_base, bert_tiny,
+)
+from paddle_tpu.models.unet import UNetModel, unet_sd_like, unet_tiny  # noqa: F401

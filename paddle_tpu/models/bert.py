@@ -71,6 +71,12 @@ class BertModel(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         h = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None and len(attention_mask.shape) == 2:
+            # BERT convention: [b, s] 1=token / 0=pad -> additive
+            # [b, 1, 1, s] logits mask broadcast over heads and queries
+            attention_mask = paddle.unsqueeze(
+                paddle.unsqueeze(
+                    (1.0 - attention_mask.astype("float32")) * -1e4, 1), 1)
         h = self.encoder(h, src_mask=attention_mask)
         pooled = self.pooler_act(self.pooler(h[:, 0]))
         return h, pooled

@@ -419,3 +419,95 @@ def test_parameter_server_sparse_training():
     finally:
         ps.shutdown_server()
         rpc.shutdown()
+
+
+def test_audio_features():
+    """paddle.audio: fbank matches librosa-style triangular filters in
+    shape/energy; feature layers produce finite outputs; MFCC dct is
+    orthonormal."""
+    sig = paddle.to_tensor(
+        np.sin(np.linspace(0, 200 * np.pi, 2048)).astype("float32")[None])
+    spec = paddle.audio.features.Spectrogram(n_fft=256)(sig)
+    assert spec.shape == [1, 129, 33]
+    lm = paddle.audio.features.LogMelSpectrogram(n_fft=256, n_mels=32,
+                                                 top_db=80.0)(sig)
+    assert lm.shape == [1, 32, 33]
+    v = lm.numpy()
+    assert np.isfinite(v).all() and v.max() - v.min() <= 80.0 + 1e-3
+    mfcc = paddle.audio.features.MFCC(n_mfcc=13, n_fft=256, n_mels=32)(sig)
+    assert mfcc.shape == [1, 13, 33]
+
+    fb = paddle.audio.functional.compute_fbank_matrix(16000, 256, 32).numpy()
+    assert fb.shape == (32, 129) and (fb >= 0).all()
+    assert (fb.sum(axis=1) > 0).all()  # every filter has support
+
+    dct = paddle.audio.functional.create_dct(13, 32).numpy()
+    np.testing.assert_allclose(dct.T @ dct, np.eye(13), atol=1e-5)
+
+    # round-trip of the mel scale
+    f = np.array([100.0, 1000.0, 4000.0])
+    np.testing.assert_allclose(
+        paddle.audio.functional.mel_to_hz(
+            paddle.audio.functional.hz_to_mel(f)), f, rtol=1e-6)
+
+
+def test_to_static_eager_fallback_on_dynamic_control_flow():
+    """Tensor-dependent Python control flow degrades to eager with a
+    warning instead of crashing (reference SOT fallback semantics)."""
+    import warnings
+
+    from paddle_tpu.jit import StaticFunction, to_static
+
+    StaticFunction._warned_eager_fallback = False
+
+    @to_static
+    def f(x):
+        if float(x.sum()) > 0:  # traced bool -> unconditionally dynamic
+            return x * 2
+        return x - 1
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out = f(paddle.to_tensor(np.array([1.0, 2.0], "float32")))
+        out2 = f(paddle.to_tensor(np.array([-5.0, -5.0], "float32")))
+    np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(out2.numpy(), [-6.0, -6.0])
+    assert any("control flow" in str(w.message) for w in rec)
+
+
+def test_audio_wav_roundtrip(tmp_path):
+    sig = np.sin(np.linspace(0, 20 * np.pi, 800)).astype("float32")[None]
+    p = str(tmp_path / "t.wav")
+    paddle.audio.save(p, paddle.to_tensor(sig), 8000)
+    meta = paddle.audio.info(p)
+    assert meta["sample_rate"] == 8000 and meta["num_frames"] == 800
+    back, sr = paddle.audio.load(p)
+    assert sr == 8000 and back.shape == [1, 800]
+    np.testing.assert_allclose(back.numpy(), sig, atol=1e-3)
+
+
+def test_bert_attention_mask_semantics():
+    """[b, s] 0/1 masks convert to additive logits masks: padded keys must
+    not influence outputs of valid positions."""
+    from paddle_tpu.models.bert import bert_tiny
+
+    paddle.seed(2)
+    model = bert_tiny()
+    model.eval()
+    ids = np.random.default_rng(0).integers(0, 1024, (2, 8)).astype("int64")
+    mask_full = np.ones((2, 8), "int64")
+    mask_pad = mask_full.copy()
+    mask_pad[:, 6:] = 0
+
+    out_pad = model(paddle.to_tensor(ids),
+                    attention_mask=paddle.to_tensor(mask_pad))[0].numpy()
+    # changing CONTENT of padded positions must not change valid outputs
+    ids2 = ids.copy()
+    ids2[:, 6:] = (ids2[:, 6:] + 123) % 1024
+    out_pad2 = model(paddle.to_tensor(ids2),
+                     attention_mask=paddle.to_tensor(mask_pad))[0].numpy()
+    np.testing.assert_allclose(out_pad[:, :6], out_pad2[:, :6], atol=1e-5)
+    # and masking must differ from not masking
+    out_full = model(paddle.to_tensor(ids),
+                     attention_mask=paddle.to_tensor(mask_full))[0].numpy()
+    assert not np.allclose(out_full[:, :6], out_pad[:, :6])
