@@ -1,0 +1,289 @@
+"""paddle.vision.ops (reference: `python/paddle/vision/ops.py` — detection
+primitives backed by `paddle/phi/kernels/*/nms_kernel.*`,
+`roi_align_kernel.*`, `box_coder_kernel.*`, `prior_box_kernel.*`).
+
+TPU-native notes: roi_align is a batched bilinear gather (vectorizes
+cleanly); nms is an O(n^2) suppression matrix + lax.fori greedy sweep —
+static shapes, no host round trip, fine at detection-head sizes (n <= a few
+thousand); box_coder/prior_box are pure elementwise math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "prior_box",
+           "box_area", "box_iou", "distribute_fpn_proposals"]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_area(boxes):
+    b = _data(boxes)
+    return Tensor((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def _iou_matrix(a, b):
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None] - inter, 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    return Tensor(_iou_matrix(_data(boxes1), _data(boxes2)))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy NMS -> kept indices sorted by score (reference ops.yaml nms /
+    vision/ops.py:nms). Category-aware when category_idxs is given (boxes
+    of different categories never suppress each other)."""
+    b = _data(boxes)
+    n = b.shape[0]
+    s = (_data(scores) if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    order = jnp.argsort(-s)
+    b_sorted = b[order]
+    iou = _iou_matrix(b_sorted, b_sorted)
+    if category_idxs is not None:
+        c = _data(category_idxs)[order]
+        same = c[:, None] == c[None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    idx = jnp.arange(n)
+
+    def body(i, keep):
+        # box i (in score order) survives unless a higher-scored SURVIVOR
+        # overlaps it beyond the threshold
+        sup = jnp.any((idx < i) & (iou[i] > iou_threshold) & keep)
+        return keep.at[i].set(~sup)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # kept indices have data-dependent count: finalize on host (the
+    # reference kernel also returns a dynamic-size index tensor)
+    keep_np = np.asarray(jax.device_get(keep))
+    order_np = np.asarray(jax.device_get(order))
+    out = order_np[keep_np]
+    if top_k is not None:
+        out = out[:top_k]
+    return Tensor(jnp.asarray(out.astype(np.int64)))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference roi_align_kernel): x [N,C,H,W]; boxes [R,4]
+    (x1,y1,x2,y2 in input coords); boxes_num [N] rois per image ->
+    [R, C, oh, ow]."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    bx = _data(boxes).astype(jnp.float32)
+    bn = np.asarray(jax.device_get(_data(boxes_num)))
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+
+    offset = 0.5 if aligned else 0.0
+
+    def fn(xd):
+        n, c, h, w = xd.shape
+
+        def one_roi(roi, img):
+            x1, y1, x2, y2 = roi * spatial_scale - offset
+            rw = jnp.maximum(x2 - x1, 1e-3)
+            rh = jnp.maximum(y2 - y1, 1e-3)
+            bin_w, bin_h = rw / ow, rh / oh
+            # ratio x ratio sample points per bin, bilinear each
+            gy = (y1 + (jnp.arange(oh)[:, None] +
+                        (jnp.arange(ratio)[None, :] + 0.5) / ratio) * bin_h)
+            gx = (x1 + (jnp.arange(ow)[:, None] +
+                        (jnp.arange(ratio)[None, :] + 0.5) / ratio) * bin_w)
+            gy = gy.reshape(-1)  # [oh*ratio]
+            gx = gx.reshape(-1)  # [ow*ratio]
+            img_feat = xd[img]  # [C, H, W]
+
+            def bilinear(yy, xx):
+                y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+                x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+                y1_ = jnp.clip(y0 + 1, 0, h - 1)
+                x1_ = jnp.clip(x0 + 1, 0, w - 1)
+                wy = jnp.clip(yy - y0, 0, 1)
+                wx = jnp.clip(xx - x0, 0, 1)
+                y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+                y1i, x1i = y1_.astype(jnp.int32), x1_.astype(jnp.int32)
+                v = (img_feat[:, y0i[:, None], x0i[None, :]] * ((1 - wy)[:, None] * (1 - wx)[None, :])
+                     + img_feat[:, y0i[:, None], x1i[None, :]] * ((1 - wy)[:, None] * wx[None, :])
+                     + img_feat[:, y1i[:, None], x0i[None, :]] * (wy[:, None] * (1 - wx)[None, :])
+                     + img_feat[:, y1i[:, None], x1i[None, :]] * (wy[:, None] * wx[None, :]))
+                return v  # [C, len(yy), len(xx)]
+
+            vals = bilinear(gy, gx)  # [C, oh*ratio, ow*ratio]
+            vals = vals.reshape(c, oh, ratio, ow, ratio)
+            return vals.mean(axis=(2, 4))
+
+        return jax.vmap(one_roi)(bx, img_of_roi).astype(xd.dtype)
+
+    return apply(fn, x, _name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI (reference roi_pool_kernel): TRUE max over every pixel
+    whose coordinates fall in a bin (sparse sampling can miss the max), via
+    per-bin masks reduced over H,W — XLA fuses the where+max so the
+    [oh,ow,H,W] mask never materializes against the channel dim."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    bx = _data(boxes).astype(jnp.float32)
+    bn = np.asarray(jax.device_get(_data(boxes_num)))
+    img_of_roi = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+
+    def fn(xd):
+        n, c, h, w = xd.shape
+        ygrid = jnp.arange(h, dtype=jnp.float32)
+        xgrid = jnp.arange(w, dtype=jnp.float32)
+
+        def one_roi(roi, img):
+            x1, y1, x2, y2 = jnp.round(roi * spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1, 1.0)
+            # bin boundaries (reference: floor/ceil of fractional edges)
+            ys0 = y1 + jnp.floor(jnp.arange(oh) * rh / oh)
+            ys1 = y1 + jnp.ceil((jnp.arange(oh) + 1) * rh / oh)
+            xs0 = x1 + jnp.floor(jnp.arange(ow) * rw / ow)
+            xs1 = x1 + jnp.ceil((jnp.arange(ow) + 1) * rw / ow)
+            my = ((ygrid[None, :] >= ys0[:, None])
+                  & (ygrid[None, :] < ys1[:, None]))   # [oh, H]
+            mx = ((xgrid[None, :] >= xs0[:, None])
+                  & (xgrid[None, :] < xs1[:, None]))   # [ow, W]
+            mask = my[:, None, :, None] & mx[None, :, None, :]  # [oh,ow,H,W]
+            feat = xd[img][None, None].astype(jnp.float32)  # [1,1,C,H,W]
+            vals = jnp.where(mask[:, :, None], feat, -jnp.inf)
+            out = vals.max(axis=(-2, -1))  # [oh, ow, C]
+            out = jnp.where(jnp.isfinite(out), out, 0.0)  # empty bins -> 0
+            return jnp.moveaxis(out, -1, 0)  # [C, oh, ow]
+
+        return jax.vmap(one_roi)(bx, img_of_roi).astype(xd.dtype)
+
+    return apply(fn, x, _name="roi_pool")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference box_coder_kernel).
+
+    encode: targets [N,4] x priors [M,4] -> [N, M, 4] (every target
+    against every prior). decode: target_box [N, M, 4] deltas; priors
+    broadcast along dim `axis` (0: priors indexed by M, 1: by N), output
+    [N, M, 4]."""
+    pb = _data(prior_box).astype(jnp.float32)
+    tb = _data(target_box).astype(jnp.float32)
+    pv = (_data(prior_box_var).astype(jnp.float32)
+          if prior_box_var is not None else jnp.ones_like(pb))
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw / 2
+    pcy = pb[:, 1] + ph / 2
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw / 2
+        tcy = tb[:, 1] + th / 2
+        # [N, 1] targets x [1, M] priors -> [N, M]
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :] / pv[None, :, 0],
+            (tcy[:, None] - pcy[None, :]) / ph[None, :] / pv[None, :, 1],
+            jnp.log(tw[:, None] / pw[None, :]) / pv[None, :, 2],
+            jnp.log(th[:, None] / ph[None, :]) / pv[None, :, 3],
+        ], axis=-1)
+    else:  # decode_center_size: tb is [N, M, 4] deltas
+        if tb.ndim == 2:
+            tb = tb[:, None, :]
+        # broadcast priors along `axis`: 0 -> index by M (dim 1),
+        # 1 -> index by N (dim 0)
+        expand = (lambda a: a[None, :]) if axis == 0 else (lambda a: a[:, None])
+        pvx = (lambda a: a[None, :]) if axis == 0 else (lambda a: a[:, None])
+        dcx = pvx(pv[:, 0]) * tb[..., 0] * expand(pw) + expand(pcx)
+        dcy = pvx(pv[:, 1]) * tb[..., 1] * expand(ph) + expand(pcy)
+        dw = jnp.exp(pvx(pv[:, 2]) * tb[..., 2]) * expand(pw)
+        dh = jnp.exp(pvx(pv[:, 3]) * tb[..., 3]) * expand(ph)
+        out = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                         dcx + dw / 2 - norm, dcy + dh / 2 - norm], axis=-1)
+    return Tensor(out)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None):
+    """SSD prior boxes (reference prior_box_kernel): -> (boxes [H,W,P,4],
+    variances [H,W,P,4]) normalized to [0,1]."""
+    fh, fw = _data(input).shape[2:]
+    ih, iw = _data(image).shape[2:]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    sizes = []
+    for ms in min_sizes:
+        for a in ars:
+            sizes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+        if max_sizes:
+            mx = max_sizes[min_sizes.index(ms)]
+            sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    sizes = np.asarray(sizes, np.float32)  # [P, 2] (w, h)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    cxg, cyg = np.meshgrid(cx, cy)
+    boxes = np.stack([
+        (cxg[..., None] - sizes[None, None, :, 0] / 2) / iw,
+        (cyg[..., None] - sizes[None, None, :, 1] / 2) / ih,
+        (cxg[..., None] + sizes[None, None, :, 0] / 2) / iw,
+        (cyg[..., None] + sizes[None, None, :, 1] / 2) / ih,
+    ], axis=-1)
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32), boxes.shape)
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var.copy()))
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Assign rois to FPN levels by scale (reference
+    distribute_fpn_proposals_kernel)."""
+    rois = _data(fpn_rois)
+    scale = jnp.sqrt((rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1]))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    lvl_np = np.asarray(jax.device_get(lvl))
+    rois_np = np.asarray(jax.device_get(rois))
+    outs, idxs = [], []
+    per_level_counts = []
+    rn = (np.asarray(jax.device_get(_data(rois_num)))
+          if rois_num is not None else None)
+    img_of = (np.repeat(np.arange(len(rn)), rn) if rn is not None else None)
+    for level in range(min_level, max_level + 1):
+        sel = np.nonzero(lvl_np == level)[0]
+        outs.append(Tensor(jnp.asarray(rois_np[sel])))
+        idxs.append(sel)
+        if rn is not None:
+            # per-image roi counts at this level (reference's third output)
+            per_level_counts.append(Tensor(jnp.asarray(np.bincount(
+                img_of[sel], minlength=len(rn)).astype(np.int32))))
+    restore = np.argsort(np.concatenate(idxs)) if idxs else np.zeros(0)
+    restore_t = Tensor(jnp.asarray(restore.astype(np.int32)))
+    if rn is not None:
+        return outs, restore_t, per_level_counts
+    return outs, restore_t

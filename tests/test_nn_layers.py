@@ -242,3 +242,72 @@ def test_vision_model_zoo_forward_backward():
         out = m(x)
         assert out.shape == [2, 10], type(m).__name__
         out.sum().backward()
+
+
+def test_vision_ops_detection_primitives():
+    """nms / roi_align / box_coder / prior_box / box_iou
+    (paddle.vision.ops; flips the r1-skipped detection primitives to
+    implemented)."""
+    from paddle_tpu.vision import ops as V
+
+    # nms: overlapping boxes collapse to the best-scored one
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60], [0, 0, 9, 9],
+    ], "float32"))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7, 0.95], "float32"))
+    kept = V.nms(boxes, iou_threshold=0.5, scores=scores).numpy().tolist()
+    # box 3 (best score) suppresses 0 (IoU .81) and 1 (IoU .55); 2 is far
+    assert kept == [3, 2], kept
+    # category-aware: same boxes, different classes -> nothing suppressed
+    cats = paddle.to_tensor(np.array([0, 1, 0, 2], "int64"))
+    kept_c = V.nms(boxes, 0.5, scores, category_idxs=cats,
+                   categories=[0, 1, 2]).numpy()
+    assert len(kept_c) == 4
+
+    # box_iou sanity
+    iou = V.box_iou(boxes[:1], boxes[1:2]).numpy()[0, 0]
+    assert 0.6 < iou < 0.75
+
+    # roi_align: constant feature map -> constant pooled values
+    feat = paddle.to_tensor(np.full((1, 2, 16, 16), 3.0, "float32"))
+    rois = paddle.to_tensor(np.array([[2, 2, 10, 10]], "float32"))
+    out = V.roi_align(feat, rois, paddle.to_tensor(np.array([1], "int32")),
+                      output_size=4)
+    assert out.shape == [1, 2, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+    outp = V.roi_pool(feat, rois, paddle.to_tensor(np.array([1], "int32")),
+                      output_size=4)
+    np.testing.assert_allclose(outp.numpy(), 3.0, rtol=1e-5)
+
+    # box_coder: encode is [N, M, 4] (every target vs every prior);
+    # decode inverts it
+    priors = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 20, 25],
+                                        [2, 2, 6, 6]], "float32"))
+    targets = paddle.to_tensor(np.array([[1, 1, 9, 12], [6, 4, 18, 28]],
+                                        "float32"))
+    enc = V.box_coder(priors, None, targets, "encode_center_size")
+    assert enc.shape == [2, 3, 4]
+    dec = V.box_coder(priors, None, enc, "decode_center_size")
+    for m in range(3):
+        np.testing.assert_allclose(dec.numpy()[:, m], targets.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    # roi_pool catches an isolated spike anywhere in the bin (true max)
+    spike = np.zeros((1, 1, 16, 16), "float32")
+    spike[0, 0, 5, 5] = 100.0
+    sp_out = V.roi_pool(paddle.to_tensor(spike),
+                        paddle.to_tensor(np.array([[0, 0, 15, 15]],
+                                                  "float32")),
+                        paddle.to_tensor(np.array([1], "int32")),
+                        output_size=2)
+    assert float(sp_out.numpy().max()) == 100.0
+
+    # prior_box: SSD priors normalized, centered correctly
+    feat_in = paddle.to_tensor(np.zeros((1, 8, 4, 4), "float32"))
+    image = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+    pb, pv = V.prior_box(feat_in, image, min_sizes=[16.0],
+                         aspect_ratios=(1.0, 2.0), clip=True)
+    assert pb.shape[:2] == [4, 4] and pb.shape[-1] == 4
+    b = pb.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    assert pv.shape == pb.shape

@@ -456,9 +456,7 @@ def test_to_static_eager_fallback_on_dynamic_control_flow():
     warning instead of crashing (reference SOT fallback semantics)."""
     import warnings
 
-    from paddle_tpu.jit import StaticFunction, to_static
-
-    StaticFunction._warned_eager_fallback = False
+    from paddle_tpu.jit import to_static
 
     @to_static
     def f(x):

@@ -243,21 +243,15 @@ SKIPS = {
     # mobile/detection zoo: out of scope for the north-star configs
     "generate_proposals": "two-stage detection zoo",
     "collect_fpn_proposals": "two-stage detection zoo",
-    "distribute_fpn_proposals": "two-stage detection zoo",
     "matrix_nms": "detection zoo",
     "multiclass_nms3": "detection zoo",
     "bipartite_match": "detection zoo",
     "box_clip": "detection zoo",
-    "box_coder": "detection zoo",
-    "prior_box": "detection zoo",
     "psroi_pool": "detection zoo",
-    "roi_align": "detection zoo",
-    "roi_pool": "detection zoo",
     "yolo_box": "detection zoo",
     "yolo_box_head": "detection zoo",
     "yolo_box_post": "detection zoo",
     "yolo_loss": "detection zoo",
-    "nms": "detection zoo",
     "deformable_conv": "detection zoo kernel",
     "correlation": "optical-flow kernel",
     "collect_fpn_proposals ": "detection zoo",
@@ -337,6 +331,8 @@ def resolve(name, paddle, F):
         ("paddle.geometric", getattr(paddle, "geometric", None)),
         ("paddle.signal", getattr(paddle, "signal", None)),
         ("paddle.text", getattr(paddle, "text", None)),
+        ("paddle.vision.ops", getattr(getattr(paddle, "vision", None),
+                                      "ops", None)),
         ("paddle.quantization", getattr(paddle, "quantization", None)),
     ]
     for label, mod in mods:
