@@ -36,6 +36,9 @@ from paddle_tpu.distributed.parallel import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv, DataParallel,
 )
 from paddle_tpu.distributed.engine import Engine  # noqa: F401
+from paddle_tpu.distributed.pipeline_engine import (  # noqa: F401
+    PipelineEngine, transformer_mp_spec,
+)
 from paddle_tpu.distributed.ring_attention import ring_attention  # noqa: F401
 
 

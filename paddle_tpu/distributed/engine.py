@@ -104,6 +104,24 @@ def _fn_adam(hp, decoupled_wd):
     return init, update, ("m", "v")
 
 
+def shard_first_free_axis(parts, shape, degree, axis="dp"):
+    """PartitionSpec sharding `axis` along the first free dim it divides —
+    the numel-partition of the reference's optimizer-state/param sharding
+    (`group_sharded_optimizer_stage2.py:53`) expressed as a dim split (which
+    keeps XLA layouts intact). No-op if `axis` is already present or nothing
+    divides."""
+    parts = list(parts) + [None] * (len(shape) - len(parts))
+    present = {a for p in parts if p is not None
+               for a in (p if isinstance(p, (tuple, list)) else (p,))}
+    if axis in present:
+        return P(*parts)
+    for i, (p, d) in enumerate(zip(parts, shape)):
+        if p is None and d % degree == 0 and d > 0:
+            parts[i] = axis
+            break
+    return P(*parts)
+
+
 def _functionalize_optimizer(opt):
     """Map a paddle_tpu.optimizer.* instance to (init, update, slot_names).
 
@@ -265,18 +283,8 @@ class Engine:
         return P(*([None] * len(shape)))
 
     def _dp_shard_spec(self, shape, base=None):
-        """Shard over 'dp' along the first free axis it divides
-        (group_sharded_optimizer_stage2.py:53 partitions by numel; on TPU a
-        dimension split keeps XLA layouts intact)."""
         parts = list(base) if base is not None else [None] * len(shape)
-        parts += [None] * (len(shape) - len(parts))  # P() is rank-agnostic
-        if "dp" in parts:  # already dp-sharded (e.g. stage-3 param spec)
-            return P(*parts)
-        for i, d in enumerate(shape):
-            if parts[i] is None and d % self.dp == 0 and d > 0:
-                parts[i] = "dp"
-                return P(*parts)
-        return P(*parts)
+        return shard_first_free_axis(parts, shape, self.dp)
 
     def _slot_spec(self, pspec, shape):
         if self.sharding_stage >= 1 and self.dp > 1:

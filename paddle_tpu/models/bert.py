@@ -14,7 +14,8 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 
 __all__ = ["BertConfig", "BertModel", "BertForPretraining",
-           "BertPretrainingLoss", "bert_base", "bert_tiny"]
+           "BertPretrainingLoss", "BertMLMHead", "BertMLMLoss",
+           "bert_pipeline_descs", "bert_base", "bert_tiny"]
 
 
 class BertConfig:
@@ -82,25 +83,35 @@ class BertModel(nn.Layer):
         return h, pooled
 
 
+class BertMLMHead(nn.Layer):
+    """MLM head (transform + norm + vocab projection). Also the last stage
+    of the pipelined BERT stack (`bert_pipeline_descs`)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.mlm_act = nn.GELU()
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_eps)
+        self.mlm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+
+    def forward(self, h):
+        return self.mlm_head(self.mlm_norm(self.mlm_act(
+            self.mlm_transform(h))))
+
+
 class BertForPretraining(nn.Layer):
     """MLM + NSP heads (the config-3 pretraining objective)."""
 
     def __init__(self, cfg: BertConfig):
         super().__init__()
         self.bert = BertModel(cfg)
-        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
-        self.mlm_act = nn.GELU()
-        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
-                                     epsilon=cfg.layer_norm_eps)
-        self.mlm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size)
+        self.mlm = BertMLMHead(cfg)
         self.nsp_head = nn.Linear(cfg.hidden_size, 2)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):
         h, pooled = self.bert(input_ids, token_type_ids, attention_mask)
-        mlm = self.mlm_head(self.mlm_norm(self.mlm_act(
-            self.mlm_transform(h))))
-        nsp = self.nsp_head(pooled)
-        return mlm, nsp
+        return self.mlm(h), self.nsp_head(pooled)
 
 
 class BertPretrainingLoss(nn.Layer):
@@ -116,6 +127,36 @@ class BertPretrainingLoss(nn.Layer):
             loss = loss + nn.functional.cross_entropy(
                 nsp_logits, paddle.reshape(nsp_labels, [-1]))
         return loss
+
+
+class BertMLMLoss(nn.Layer):
+    """MLM-only CE (-100 = ignore) — the pipelined objective (NSP needs the
+    pooled [CLS], which does not ride the single-tensor pipeline chain)."""
+
+    def forward(self, mlm_logits, mlm_labels):
+        vocab = mlm_logits.shape[-1]
+        return nn.functional.cross_entropy(
+            paddle.reshape(mlm_logits, [-1, vocab]),
+            paddle.reshape(mlm_labels, [-1]), ignore_index=-100)
+
+
+def bert_pipeline_descs(cfg: BertConfig):
+    """LayerDesc stack for `PipelineLayer` (reference pp_layers.py:264
+    segmentation): [embeddings] + N encoder layers + [MLM head]. Feed to
+    `distributed.PipelineEngine` for compiled pp x mp x dp training."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import LayerDesc
+
+    descs = [BertEmbeddings(cfg)]
+    descs += [LayerDesc(nn.TransformerEncoderLayer,
+                        d_model=cfg.hidden_size,
+                        nhead=cfg.num_attention_heads,
+                        dim_feedforward=cfg.intermediate_size,
+                        dropout=cfg.hidden_dropout_prob,
+                        activation="gelu",
+                        layer_norm_eps=cfg.layer_norm_eps)
+              for _ in range(cfg.num_hidden_layers)]
+    descs.append(BertMLMHead(cfg))
+    return descs
 
 
 def bert_base(**kwargs):

@@ -15,3 +15,6 @@ from paddle_tpu.vision.models.shufflenetv2 import (  # noqa: F401
     ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_5,
     shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
 )
+from paddle_tpu.vision.models.vit import (  # noqa: F401
+    VisionTransformer, vit_b_16, vit_tiny, vit_pipeline_descs,
+)
