@@ -1,12 +1,41 @@
-"""Checkpoint metadata (reference `distributed/checkpoint/metadata.py`):
-a global map tensor-name -> {shape, dtype, shard files} that makes
-reshard-on-load across different meshes/degrees possible."""
+"""Checkpoint metadata (reference `distributed/checkpoint/metadata.py`:
+`Metadata.state_dict_metadata` maps tensor-name -> list of local-shard
+descriptors, which is what makes reshard-on-load across different
+meshes/degrees possible — the loader intersects saved shards with the
+destination shards).
+
+Format v2: every tensor is stored as one or more SHARD files, each covering
+a hyper-rectangle [offset, offset+length) of the global shape. v1 files
+(one whole-tensor .npy per tensor) still load.
+"""
 
 from __future__ import annotations
 
 import dataclasses
+import glob
 import json
+import os
 from typing import Dict, List, Optional
+
+_META_FILE = "metadata.json"
+
+
+@dataclasses.dataclass
+class ShardMetadata:
+    file: str
+    offsets: List[int]   # global start per dim
+    lengths: List[int]   # extent per dim
+
+
+def norm_index(index, shape):
+    """Slice-tuple (jax shard index / destination block) -> (starts, stops),
+    normalizing None endpoints. The single source of shard geometry for both
+    save and load."""
+    starts, stops = [], []
+    for sl, dim in zip(index, shape):
+        starts.append(0 if sl.start is None else int(sl.start))
+        stops.append(dim if sl.stop is None else int(sl.stop))
+    return starts, stops
 
 
 @dataclasses.dataclass
@@ -14,18 +43,24 @@ class TensorMetadata:
     name: str
     shape: List[int]
     dtype: str
-    file: str
+    file: Optional[str] = None           # v1: one whole-tensor file
+    shards: Optional[List[ShardMetadata]] = None  # v2: shard files
     # sharding at save time, informational (load reshards to the target's
     # current sharding regardless)
     mesh_shape: Optional[List[int]] = None
     mesh_axes: Optional[List[str]] = None
     partition_spec: Optional[List] = None
 
+    def __post_init__(self):
+        if self.shards is not None:
+            self.shards = [s if isinstance(s, ShardMetadata)
+                           else ShardMetadata(**s) for s in self.shards]
+
 
 @dataclasses.dataclass
 class Metadata:
     tensors: Dict[str, TensorMetadata] = dataclasses.field(default_factory=dict)
-    version: int = 1
+    version: int = 2
 
     def dump(self, path):
         with open(path, "w") as f:
@@ -43,3 +78,26 @@ class Metadata:
         for k, v in raw["tensors"].items():
             md.tensors[k] = TensorMetadata(**v)
         return md
+
+    @staticmethod
+    def load_dir(ckpt_dir):
+        """Merge every process's metadata file (multi-host save writes
+        `metadata.json` on process 0 and `metadata.{p}.json` elsewhere,
+        mirroring the reference's per-rank metadata gather)."""
+        paths = sorted(glob.glob(os.path.join(ckpt_dir, "metadata*.json")))
+        if not paths:
+            raise FileNotFoundError(
+                f"no metadata*.json in checkpoint dir {ckpt_dir}")
+        merged = None
+        for p in paths:
+            md = Metadata.load(p)
+            if merged is None:
+                merged = md
+                continue
+            for name, tm in md.tensors.items():
+                if name in merged.tensors and tm.shards:
+                    have = merged.tensors[name]
+                    have.shards = (have.shards or []) + tm.shards
+                else:
+                    merged.tensors[name] = tm
+        return merged

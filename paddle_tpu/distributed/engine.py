@@ -160,6 +160,25 @@ def _functionalize_optimizer(opt):
         f"Engine supports SGD/Momentum/Adam/AdamW, got {type(opt).__name__}")
 
 
+def apply_optimizer_updates(params, grads, opt_state, opt_update, slots, lr,
+                            decay_mask=None):
+    """One functional optimizer step over a flat {name: array} tree —
+    shared by the Engine and PipelineEngine compiled steps."""
+    step = opt_state["step"] + 1
+    new_params, new_slots = {}, {name: {} for name in slots}
+    for k, p in params.items():
+        s = tuple(opt_state[name][k] for name in slots)
+        kw = ({"step": step, "decay": (decay_mask or {}).get(k, True)}
+              if "m" in slots else {})
+        np_, ns = opt_update(p, grads[k], s, lr, **kw)
+        new_params[k] = np_
+        for name, val in zip(slots, ns):
+            new_slots[name][k] = val
+    new_opt = dict(new_slots)
+    new_opt["step"] = step
+    return new_params, new_opt
+
+
 def _functional_grad_clip(clip, clipable):
     """Pure-pytree version of Optimizer._apply_grad_clip (optimizer.py:86).
     `clipable` maps param name -> need_clip (params with need_clip=False are
@@ -362,18 +381,9 @@ class Engine:
                 loss_fn, has_aux=True)(params, buffers, key, inputs, labels)
             if grad_clip is not None:
                 grads = grad_clip(grads)
-            step = opt_state["step"] + 1
-            new_params, new_slots = {}, {name: {} for name in slots}
-            for k, p in params.items():
-                s = tuple(opt_state[name][k] for name in slots)
-                kw = ({"step": step, "decay": self._decay_mask.get(k, True)}
-                      if "m" in slots else {})
-                np_, ns = opt_update(p, grads[k], s, lr, **kw)
-                new_params[k] = np_
-                for name, val in zip(slots, ns):
-                    new_slots[name][k] = val
-            new_opt = dict(new_slots)
-            new_opt["step"] = step
+            new_params, new_opt = apply_optimizer_updates(
+                params, grads, opt_state, opt_update, slots, lr,
+                self._decay_mask)
             return loss, new_params, new_opt, new_buf
 
         out_opt_shard = getattr(self, "_oshard", None)

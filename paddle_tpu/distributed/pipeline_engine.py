@@ -448,22 +448,15 @@ class PipelineEngine:
         grad_clip = self._grad_clip
 
         def train_step(params, opt_state, key, lr, inputs, labels):
+            from paddle_tpu.distributed.engine import apply_optimizer_updates
+
             loss, grads = jax.value_and_grad(self._pipeline_loss)(
                 params, key, inputs, labels)
             if grad_clip is not None:
                 grads = grad_clip(grads)
-            step = opt_state["step"] + 1
-            new_params, new_slots = {}, {name: {} for name in slots}
-            for k, p in params.items():
-                s = tuple(opt_state[name][k] for name in slots)
-                kw = ({"step": step, "decay": self._decay_mask.get(k, True)}
-                      if "m" in slots else {})
-                np_, ns = opt_update(p, grads[k], s, lr, **kw)
-                new_params[k] = np_
-                for name, val in zip(slots, ns):
-                    new_slots[name][k] = val
-            new_opt = dict(new_slots)
-            new_opt["step"] = step
+            new_params, new_opt = apply_optimizer_updates(
+                params, grads, opt_state, opt_update, slots, lr,
+                self._decay_mask)
             return loss, new_params, new_opt
 
         self._train_step = jax.jit(
@@ -479,8 +472,8 @@ class PipelineEngine:
             a = np.asarray(a.numpy() if hasattr(a, "numpy") else a)
             if a.shape[0] % (M * self.dp) != 0:
                 raise ValueError(
-                    f"global batch {a.shape[0]} must divide "
-                    f"micro_batches*dp={M * self.dp}")
+                    f"micro_batches*dp={M * self.dp} must evenly divide "
+                    f"the global batch ({a.shape[0]})")
             a = a.reshape((M, a.shape[0] // M) + a.shape[1:])
             spec = P(None, "dp", *([None] * (a.ndim - 2)))
             out.append(jax.device_put(a, self._sharding(spec)))

@@ -176,6 +176,59 @@ def test_checkpoint_roundtrip_with_reshard(tmp_path):
     assert "y" in str(w2._data.sharding.spec)
 
 
+def test_checkpoint_sharded_files_no_full_gather(tmp_path):
+    """VERDICT r2 item 2: save writes per-SHARD files (each 1/n of the
+    tensor), never one full-tensor file — the full logical value must not
+    materialize on the host."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.checkpoint import save_state_dict
+    from paddle_tpu.distributed.checkpoint.metadata import Metadata
+    from paddle_tpu.distributed.process_mesh import ProcessMesh
+    from paddle_tpu.distributed.placement import Shard
+
+    n = jax.device_count()
+    mesh = ProcessMesh(np.arange(n), ["x"])
+    w = paddle.to_tensor(np.arange(8 * n * 4, dtype=np.float32
+                                   ).reshape(8 * n, 4))
+    ws = dist.shard_tensor(w, mesh, [Shard(0)])
+    save_state_dict({"w": ws}, str(tmp_path / "ck"))
+    md = Metadata.load_dir(str(tmp_path / "ck"))
+    shards = md.tensors["w"].shards
+    assert len(shards) == n                     # one file per device shard
+    for sm in shards:
+        assert sm.lengths == [8, 4]             # 1/n of the rows each
+        f = np.load(str(tmp_path / "ck" / sm.file))
+        assert f.shape == (8, 4)
+        np.testing.assert_allclose(
+            f, w.numpy()[sm.offsets[0]:sm.offsets[0] + 8])
+
+
+def test_checkpoint_shard_intersection_reshard(tmp_path):
+    """Save row-sharded over n devices, load column-sharded over a
+    different mesh: every destination shard is assembled from multiple
+    intersecting saved shard files (the reference's get_local_load_files
+    intersection, load_state_dict.py)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.checkpoint import (
+        load_state_dict, save_state_dict)
+    from paddle_tpu.distributed.process_mesh import ProcessMesh
+    from paddle_tpu.distributed.placement import Replicate, Shard
+
+    n = jax.device_count()
+    mesh_a = ProcessMesh(np.arange(n), ["x"])
+    mesh_b = ProcessMesh(np.arange(n).reshape(n // 2, 2), ["a", "b"])
+    w = paddle.to_tensor(
+        np.arange(4 * n * 2 * n, dtype=np.float32).reshape(4 * n, 2 * n))
+    ws = dist.shard_tensor(w, mesh_a, [Shard(0), Replicate()])
+    save_state_dict({"w": ws}, str(tmp_path / "ck"))
+
+    w2 = dist.shard_tensor(paddle.zeros([4 * n, 2 * n]), mesh_b,
+                           [Replicate(), Shard(1)])
+    load_state_dict({"w": w2}, str(tmp_path / "ck"))
+    np.testing.assert_allclose(w2.numpy(), w.numpy())
+    assert "b" in str(w2._data.sharding.spec)
+
+
 def test_checkpoint_async_save(tmp_path):
     from paddle_tpu.distributed.checkpoint import load_state_dict, save_state_dict
 
