@@ -9,10 +9,12 @@ TPU-native: 1F1B exists to bound activation memory *per rank process*; its
 loss/grad math is exactly gradient accumulation over micro-batches. Under a
 single controller the eager trainer runs micro-batches through all stages in
 order and accumulates grads — bit-identical losses to the reference schedule
-— while the *performance* schedule (stage-sharded scan + collective-permute
-over the 'pp' mesh axis, riding ICI) lives in the compiled path
-(`paddle_tpu.parallel.pipeline`), which the driver's multichip dry-run and
-bench use. Activation memory in eager is bounded by recompute_interval.
+— while the *performance* schedules (stage-sharded scan + collective-permute
+over the 'pp' mesh axis, riding ICI) live in the compiled paths:
+`paddle_tpu.distributed.hybrid_engine.HybridParallelEngine` (flagship
+Llama, gpipe/1f1b/VPP/zero-bubble) and
+`paddle_tpu.distributed.pipeline_engine.PipelineEngine` (any
+PipelineLayer). Activation memory in eager is bounded by recompute_interval.
 """
 
 from __future__ import annotations

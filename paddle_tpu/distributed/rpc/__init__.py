@@ -93,18 +93,33 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
                   if world_size is None else world_size)
 
-    # worker server: bind all interfaces, advertise a peer-reachable address
-    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("0.0.0.0", 0))
-    srv.listen(64)
-    port = srv.getsockname()[1]
+    # Worker server. SECURITY: rpc executes pickled frames from peers, so
+    # (like the reference's brpc agent) it assumes a TRUSTED network; bind
+    # only the advertised interface (PADDLE_LOCAL_IP), never 0.0.0.0, to
+    # keep the exposure to that network (ADVICE r2).
     ip = os.environ.get("PADDLE_LOCAL_IP")
     if not ip:
         try:
             ip = socket.gethostbyname(socket.gethostname())
         except OSError:
             ip = "127.0.0.1"
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        srv.bind((ip, 0))
+    except OSError:
+        # advertised IP not locally bindable (NAT): fall back to
+        # all-interfaces but KEEP advertising the configured address so
+        # remote peers still reach us; warn that exposure widened
+        import warnings
+
+        warnings.warn(
+            f"init_rpc: PADDLE_LOCAL_IP {ip!r} is not bindable on this "
+            "host; listening on 0.0.0.0 instead (rpc executes pickled "
+            "frames — ensure the network is trusted)")
+        srv.bind(("0.0.0.0", 0))
+    srv.listen(64)
+    port = srv.getsockname()[1]
     threading.Thread(target=_serve, args=(srv,), daemon=True).start()
 
     store = None

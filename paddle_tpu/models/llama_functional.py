@@ -187,7 +187,7 @@ def decoder_layer(p, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
 
 
 def run_layers(stack, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
-               sp=False, remat=True, zero_axis=None):
+               sp=False, remat=True, zero_axis=None, zero_skip=()):
     """lax.scan over stacked layer params (leading dim = layers).
 
     remat: True/'full' (recompute everything — min memory), 'half'
@@ -200,16 +200,20 @@ def run_layers(stack, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
     arrive SHARDED over this mesh axis; each scan step all-gathers just its
     layer's weights right before use (the stage-3 pre-forward hook) and the
     gather's AD transpose is psum_scatter — grads leave reduce-scattered to
-    their owner shards with no hand-written reducer."""
+    their owner shards with no hand-written reducer.
+
+    zero_skip: leaf names that arrive REPLICATED over zero_axis (their first
+    param axis did not divide the shard degree — the engine's per-leaf
+    fallback) and therefore must not be gathered."""
     base_body = functools.partial(decoder_layer, args=args, mp_axis=mp_axis,
                                   mp_degree=mp_degree, sp=sp)
     if zero_axis is None:
         body = base_body
     else:
         def body(lp, h, cos, sin):
-            full = jax.tree.map(
-                lambda a: jax.lax.all_gather(a, zero_axis, axis=0,
-                                             tiled=True), lp)
+            full = {k: (a if k in zero_skip else
+                        jax.lax.all_gather(a, zero_axis, axis=0, tiled=True))
+                    for k, a in lp.items()}
             return base_body(full, h, cos, sin)
     if remat == "half" and stack_leading_dim(stack) % 2 != 0:
         import warnings

@@ -227,6 +227,7 @@ def binomial(count, prob, name=None):
     p = prob._data if isinstance(prob, Tensor) else jnp.asarray(prob)
     out = jax.random.binomial(_rng.next_key(), c.astype(jnp.float32),
                               p.astype(jnp.float32))
-    # reference returns int64; int32 is the widest default int with
-    # jax_enable_x64 off (framework-wide convention, see dtypes.py)
-    return Tensor(out.astype(jnp.int32))
+    # reference returns int64; with jax_enable_x64 on we match it, otherwise
+    # int32 is the widest default int (framework-wide convention, dtypes.py)
+    dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    return Tensor(out.astype(dt))

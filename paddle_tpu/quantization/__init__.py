@@ -102,8 +102,10 @@ def quantize_linear(x, scale, zero_point=0, bit_length=8, quant_axis=-1,
         shape = [1] * x._data.ndim
         shape[quant_axis] = -1
         s = s.reshape(shape)
+    # symmetric [-bnd, bnd] like the rest of the fake-quant family and the
+    # reference fake_quantize kernels (one consistent clipping convention)
     q = jnp.clip(jnp.round(x._data / jnp.maximum(s, 1e-9)) + zero_point,
-                 -bnd - 1, bnd)
+                 -bnd, bnd)
     return Tensor(q.astype(jnp.int8))
 
 

@@ -87,13 +87,29 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     oh, ow = output_size
-    ratio = sampling_ratio if sampling_ratio > 0 else 2
 
     bx = _data(boxes).astype(jnp.float32)
     bn = np.asarray(jax.device_get(_data(boxes_num)))
     img_of_roi = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
 
     offset = 0.5 if aligned else 0.0
+
+    # sampling_ratio <= 0: per-RoI adaptive count ceil(roi_h / pooled_h)
+    # like the reference roi_align_kernel; the grid buffer is statically
+    # sized to the LARGEST RoI's count (capped at 8 per bin dim so one
+    # whole-image box cannot inflate every RoI's grid to OOM scale — beyond
+    # ~8 samples/bin the bin mean has converged) and smaller RoIs mask the
+    # tail slots.
+    _ADAPTIVE_CAP = 8
+    if sampling_ratio > 0:
+        Ry = Rx = int(sampling_ratio)
+    else:
+        bhost = np.asarray(jax.device_get(bx), np.float32)
+        rh_all = np.maximum((bhost[:, 3] - bhost[:, 1]) * spatial_scale, 1e-3)
+        rw_all = np.maximum((bhost[:, 2] - bhost[:, 0]) * spatial_scale, 1e-3)
+        Ry = max(1, int(np.ceil(rh_all.max() / oh))) if len(bhost) else 1
+        Rx = max(1, int(np.ceil(rw_all.max() / ow))) if len(bhost) else 1
+        Ry, Rx = min(Ry, _ADAPTIVE_CAP), min(Rx, _ADAPTIVE_CAP)
 
     def fn(xd):
         n, c, h, w = xd.shape
@@ -103,16 +119,30 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             rw = jnp.maximum(x2 - x1, 1e-3)
             rh = jnp.maximum(y2 - y1, 1e-3)
             bin_w, bin_h = rw / ow, rh / oh
-            # ratio x ratio sample points per bin, bilinear each
-            gy = (y1 + (jnp.arange(oh)[:, None] +
-                        (jnp.arange(ratio)[None, :] + 0.5) / ratio) * bin_h)
-            gx = (x1 + (jnp.arange(ow)[:, None] +
-                        (jnp.arange(ratio)[None, :] + 0.5) / ratio) * bin_w)
-            gy = gy.reshape(-1)  # [oh*ratio]
-            gx = gx.reshape(-1)  # [ow*ratio]
+            if sampling_ratio > 0:
+                ry = jnp.asarray(Ry, jnp.float32)
+                rx = jnp.asarray(Rx, jnp.float32)
+            else:
+                ry = jnp.clip(jnp.ceil(rh / oh), 1, Ry)
+                rx = jnp.clip(jnp.ceil(rw / ow), 1, Rx)
+            ky = jnp.arange(Ry, dtype=jnp.float32)
+            kx = jnp.arange(Rx, dtype=jnp.float32)
+            my = (ky < ry).astype(jnp.float32)  # active sample slots
+            mx = (kx < rx).astype(jnp.float32)
+            gy = (y1 + (jnp.arange(oh)[:, None] + (ky[None, :] + 0.5) / ry)
+                  * bin_h)
+            gx = (x1 + (jnp.arange(ow)[:, None] + (kx[None, :] + 0.5) / rx)
+                  * bin_w)
+            gy = gy.reshape(-1)  # [oh*Ry]
+            gx = gx.reshape(-1)  # [ow*Rx]
             img_feat = xd[img]  # [C, H, W]
 
             def bilinear(yy, xx):
+                # reference zeroes samples with y < -1 or y > H (outside the
+                # feature map beyond the half-pixel border) instead of
+                # border-clamping them
+                vy = ((yy >= -1) & (yy <= h)).astype(jnp.float32)
+                vx = ((xx >= -1) & (xx <= w)).astype(jnp.float32)
                 y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
                 x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
                 y1_ = jnp.clip(y0 + 1, 0, h - 1)
@@ -125,11 +155,13 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                      + img_feat[:, y0i[:, None], x1i[None, :]] * ((1 - wy)[:, None] * wx[None, :])
                      + img_feat[:, y1i[:, None], x0i[None, :]] * (wy[:, None] * (1 - wx)[None, :])
                      + img_feat[:, y1i[:, None], x1i[None, :]] * (wy[:, None] * wx[None, :]))
-                return v  # [C, len(yy), len(xx)]
+                return v * (vy[:, None] * vx[None, :])  # [C, len(yy), len(xx)]
 
-            vals = bilinear(gy, gx)  # [C, oh*ratio, ow*ratio]
-            vals = vals.reshape(c, oh, ratio, ow, ratio)
-            return vals.mean(axis=(2, 4))
+            vals = bilinear(gy, gx)  # [C, oh*Ry, ow*Rx]
+            vals = vals.reshape(c, oh, Ry, ow, Rx)
+            vals = vals * my[None, None, :, None, None] \
+                * mx[None, None, None, None, :]
+            return vals.sum(axis=(2, 4)) / (ry * rx)
 
         return jax.vmap(one_roi)(bx, img_of_roi).astype(xd.dtype)
 
