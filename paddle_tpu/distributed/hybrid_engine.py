@@ -884,8 +884,21 @@ class HybridParallelEngine:
         return self._train_step
 
     def shard_batch(self, ids, labels):
-        """[B, s] host arrays -> [M, B/M, s] device arrays sharded over dp."""
+        """[B, s] host arrays -> [M, B/M, s] device arrays sharded over dp.
+
+        Already-placed [M, mb, s] jax.Arrays pass through untouched, so an
+        input pipeline can stage the next batch to device while the current
+        step runs (the reference DataLoader's pinned-memory prefetch,
+        `io/dataloader/dataloader_iter.py`) and train_batch won't re-pay
+        the h2d."""
         M = self.micro_batches
+
+        def placed(a):
+            return (isinstance(a, jax.Array) and a.ndim == 3
+                    and a.shape[0] == M)
+
+        if placed(ids) and placed(labels):
+            return ids, labels
         B = ids.shape[0]
         if B % (M * self.dp) != 0:
             raise ValueError(f"batch {B} must divide micro_batches*dp={M * self.dp}")
