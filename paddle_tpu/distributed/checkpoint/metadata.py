@@ -98,6 +98,9 @@ class Metadata:
                 if name in merged.tensors and tm.shards:
                     have = merged.tensors[name]
                     have.shards = (have.shards or []) + tm.shards
-                else:
+                elif name not in merged.tensors:
+                    # an already-known tensor with an EMPTY shard list (this
+                    # process held no replica-0 shard of it) must not clobber
+                    # shards merged from other processes' files
                     merged.tensors[name] = tm
         return merged

@@ -102,10 +102,11 @@ def quantize_linear(x, scale, zero_point=0, bit_length=8, quant_axis=-1,
         shape = [1] * x._data.ndim
         shape[quant_axis] = -1
         s = s.reshape(shape)
-    # symmetric [-bnd, bnd] like the rest of the fake-quant family and the
-    # reference fake_quantize kernels (one consistent clipping convention)
+    # ONNX-style linear quant: qmin = -qmax - 1 ([-128, 127] for int8), the
+    # reference LinearQuanter convention (quanter/format.py) — distinct from
+    # the symmetric fake-quant family above which clips to [-bnd, bnd]
     q = jnp.clip(jnp.round(x._data / jnp.maximum(s, 1e-9)) + zero_point,
-                 -bnd, bnd)
+                 -bnd - 1, bnd)
     return Tensor(q.astype(jnp.int8))
 
 

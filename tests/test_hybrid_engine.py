@@ -531,3 +531,65 @@ def test_zero3_nondivisible_leaf_fallback():
     ref_loss = lf.forward_and_loss(ref_params, jnp.asarray(ids),
                                    jnp.asarray(labels), args, remat=False)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-4)
+
+
+@pytest.mark.parametrize("micro_batches", [1, 2])
+def test_trivial_mesh_fast_path_parity(micro_batches):
+    """dp=pp=mp=1 routes to the plain-jit fast path (_grads_trivial): loss
+    and one optimizer step must match the bare value_and_grad program it is
+    supposed to compile to (the r2 bench math). Guards the engine-path
+    throughput recovery (VERDICT r3 item 1)."""
+    from paddle_tpu.distributed.hybrid_engine import adamw_init, adamw_update
+
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=1, pp=1, mp=1,
+                               micro_batches=micro_batches, lr=1e-3)
+    params, opt = eng.init_state(0)
+    ids, labels = _batch()
+    loss, new_params, new_opt = eng.train_batch(params, opt, ids, labels)
+
+    args = lf.LlamaArgs.from_config(cfg)
+    ref_params = lf.init_params(args, jax.random.key(0))
+    ref_opt = adamw_init(ref_params)
+    M = micro_batches
+    iM = np.asarray(ids).reshape(M, ids.shape[0] // M, -1)
+    lM = np.asarray(labels).reshape(M, ids.shape[0] // M, -1)
+    losses, gacc = [], None
+    for m in range(M):
+        l, g = jax.value_and_grad(lf.forward_and_loss)(
+            ref_params, jnp.asarray(iM[m]), jnp.asarray(lM[m]), args,
+            remat=True)
+        losses.append(l)
+        gacc = g if gacc is None else jax.tree.map(jnp.add, gacc, g)
+    ref_grads = jax.tree.map(lambda g: g / M, gacc)
+    ref_loss = sum(float(l) for l in losses) / M
+    ref_new, _ = adamw_update(ref_params, ref_grads, ref_opt, lr=1e-3)
+
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+    for path, p in jax.tree_util.tree_flatten_with_path(new_params)[0]:
+        rp = ref_new
+        for k in path:
+            rp = rp[k.key]
+        np.testing.assert_allclose(
+            np.asarray(p), np.asarray(rp), rtol=1e-4, atol=5e-5,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_shard_batch_rejects_bad_preplaced():
+    """Pre-placed [M, mb, s] arrays must carry the expected dp sharding and
+    a dp-divisible micro-batch dim (ADVICE r3)."""
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=2, pp=2, mp=2, micro_batches=2)
+    ids, labels = _batch()
+    # correctly placed passes through unchanged
+    i2, l2 = eng.shard_batch(ids, labels)
+    i3, l3 = eng.shard_batch(i2, l2)
+    assert i3 is i2 and l3 is l2
+    # right shape, wrong (replicated) sharding -> rejected
+    bad = jnp.asarray(np.asarray(i2))
+    with pytest.raises(ValueError, match="sharding"):
+        eng.shard_batch(bad, bad)
+    # micro-batch dim not divisible by dp -> rejected before sharding check
+    odd = jnp.zeros((2, 3, 8), jnp.int32)
+    with pytest.raises(ValueError, match="divisible by dp"):
+        eng.shard_batch(odd, odd)

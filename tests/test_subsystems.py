@@ -562,3 +562,24 @@ def test_bert_attention_mask_semantics():
     out_full = model(paddle.to_tensor(ids),
                      attention_mask=paddle.to_tensor(mask_full))[0].numpy()
     assert not np.allclose(out_full[:, :6], out_pad[:, :6])
+
+
+def test_metadata_merge_empty_shards_do_not_clobber(tmp_path):
+    """Multi-host metadata merge (ADVICE r3 medium): a process that holds no
+    replica-0 shard of a tensor writes an empty shards list; merging its file
+    LAST (metadata.json sorts after metadata.1.json) must not erase the real
+    shards merged earlier."""
+    from paddle_tpu.distributed.checkpoint.metadata import (
+        Metadata, ShardMetadata, TensorMetadata)
+
+    real = Metadata(tensors={"w": TensorMetadata(
+        name="w", shape=[4], dtype="float32",
+        shards=[ShardMetadata(file="w.0.npy", offsets=[0], lengths=[4])])})
+    empty = Metadata(tensors={"w": TensorMetadata(
+        name="w", shape=[4], dtype="float32", shards=[])})
+    # process-1 file sorts BEFORE process-0's metadata.json
+    real.dump(str(tmp_path / "metadata.1.json"))
+    empty.dump(str(tmp_path / "metadata.json"))
+    merged = Metadata.load_dir(str(tmp_path))
+    assert merged.tensors["w"].shards, "empty entry clobbered real shards"
+    assert merged.tensors["w"].shards[0].file == "w.0.npy"
