@@ -74,17 +74,30 @@ def transformer_mp_spec(name, shape):
 
 
 class _Fn:
-    """One functionalized (or plain-callable) layer in the stack."""
+    """One functionalized (or plain-callable) layer in the stack.
 
-    __slots__ = ("fn", "params", "buffers", "layer", "sig")
+    `shared_key` marks a SharedLayerDesc occurrence (reference
+    pp_layers.py:77): every occurrence functionalizes the SAME layer object
+    (possibly through its desc's forward_func) and reads its params from
+    ONE flat entry (`shared.{key}.*`) — tying is a single logical parameter
+    used at several program points, so AD *sums* the occurrences'
+    cotangents, which is exactly the reference's tied-grad allreduce
+    (pipeline_parallel.py _sync_shared_params) with no hand-written
+    collective."""
 
-    def __init__(self, layer):
+    __slots__ = ("fn", "params", "buffers", "layer", "sig", "prefix",
+                 "shared_key")
+
+    def __init__(self, layer, forward=None, shared_key=None):
         from paddle_tpu import jit as pjit
         from paddle_tpu.nn.layer.layers import Layer
 
         self.layer = layer
+        self.prefix = f"shared.{shared_key}." if shared_key else None
+        self.shared_key = shared_key
         if isinstance(layer, Layer):
-            self.fn, self.params, self.buffers = pjit.functionalize(layer)
+            self.fn, self.params, self.buffers = pjit.functionalize(
+                layer, forward=forward)
             self.sig = (
                 type(layer).__name__,
                 tuple(sorted((k, tuple(v.shape), str(v.dtype))
@@ -131,16 +144,12 @@ class PipelineEngine:
                  micro_batches=None, mp_spec_fn=None, sharding_stage=1,
                  devices=None, remat=True, seed=0, lr=None):
         from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
-            PipelineLayer)
+            PipelineLayer, SharedLayerDesc)
 
+        descs = None
         if isinstance(model, PipelineLayer):
-            if getattr(model, "_shared", None):
-                raise NotImplementedError(
-                    "PipelineEngine does not support SharedLayerDesc weight "
-                    "tying yet: each occurrence would be functionalized as "
-                    "an independent param copy (silently untying). Use the "
-                    "eager PipelineLayer path or untied weights.")
             layers = list(model.run_function)
+            descs = list(model._layers_desc)
             pp = pp or model.get_num_stages()
             loss = loss if loss is not None else model._loss_fn
         elif isinstance(model, (list, tuple)):
@@ -161,13 +170,43 @@ class PipelineEngine:
         self._lr = lr
         self._key = jax.random.key(seed)
 
-        fns = [_Fn(l) for l in layers]
+        fns = []
+        for i, layer in enumerate(layers):
+            d = descs[i] if descs is not None else None
+            if isinstance(d, SharedLayerDesc):
+                shared_layer = model._shared[d.layer_name]
+                fwd = None
+                if d.forward_func is not None:
+                    fwd = (lambda lyr, f: lambda *x: f(lyr, *x))(
+                        shared_layer, d.forward_func)
+                fns.append(_Fn(shared_layer, forward=fwd,
+                               shared_key=d.layer_name))
+            else:
+                fns.append(_Fn(layer))
         b0, b1 = self._find_body(fns)
         self._pre = list(enumerate(fns))[:b0]
         self._body = fns[b0:b1]
         self._post = list(enumerate(fns))[b1:]
+        for idx, f in self._pre + self._post:
+            if f.prefix is None:
+                f.prefix = f"l{idx}."
         self._unit_fn = self._body[0].fn
-        self._units_per_stage = (b1 - b0) // self.pp
+        # uneven segmentation (reference SegmentLayers seg_method uneven
+        # cuts, pp_layers.py:264): units_per_stage = ceil(n/pp); stages
+        # short of that are padded with a COPY of their last real unit
+        # whose output is masked out of the chunk scan — copy (not zeros)
+        # keeps arbitrary unit math NaN-free, the mask keeps it inert
+        n_body = b1 - b0
+        self._units_per_stage = -(-n_body // self.pp)
+        base, rem = divmod(n_body, self.pp)
+        self._stage_counts = [base + (1 if s < rem else 0)
+                              for s in range(self.pp)]
+        self._seg_mask = None
+        if rem:
+            self._seg_mask = np.zeros(
+                (self.pp, self._units_per_stage), bool)
+            for s, c in enumerate(self._stage_counts):
+                self._seg_mask[s, :c] = True
 
         devices = devices if devices is not None else jax.devices()
         n = self.dp * self.pp * self.mp
@@ -192,18 +231,21 @@ class PipelineEngine:
 
     # -- structure ----------------------------------------------------------
     def _find_body(self, fns):
-        """Longest run of structurally identical parameterized layers; its
-        front is trimmed so the run length divides pp (trimmed layers join
-        the pre segment). Mirrors the reference's SegmentLayers uniform cut
-        over the repeated LayerDescs (pp_layers.py:264)."""
+        """Longest run of structurally identical parameterized layers.
+        SharedLayerDesc occurrences never join the body (their params live
+        under one tied flat entry, which the stacked layout can't express).
+        Mirrors the reference's SegmentLayers cut over the repeated
+        LayerDescs (pp_layers.py:264); non-divisible run lengths are
+        handled by mask-padding in _assemble/_stage_chunk."""
         best = (0, 0)
         i = 0
         while i < len(fns):
-            if not fns[i].params:
+            if not fns[i].params or fns[i].shared_key is not None:
                 i += 1
                 continue
             j = i
-            while j < len(fns) and fns[j].sig == fns[i].sig:
+            while (j < len(fns) and fns[j].sig == fns[i].sig
+                   and fns[j].shared_key is None):
                 j += 1
             if j - i > best[1] - best[0]:
                 best = (i, j)
@@ -215,8 +257,7 @@ class PipelineEngine:
                 f"pipeline body has {n} homogeneous layers < pp={self.pp}; "
                 "PipelineEngine needs a repeated (structurally identical) "
                 "middle block of at least pp layers")
-        trim = n % self.pp
-        return b0 + trim, b1
+        return b0, b1
 
     def _per_param_masks(self, optimizer):
         """Flat-name need_clip + AdamW decay masks (Engine keeps the same
@@ -233,8 +274,8 @@ class PipelineEngine:
         clipable, decay = {}, {}
         for idx, f in self._pre + self._post:
             for k, (nc, dc) in one(f).items():
-                clipable[f"l{idx}.{k}"] = nc
-                decay[f"l{idx}.{k}"] = dc
+                clipable[f.prefix + k] = nc
+                decay[f.prefix + k] = dc
         per_unit = [one(f) for f in self._body]
         for k in per_unit[0]:
             vals = [u[k] for u in per_unit]
@@ -268,19 +309,31 @@ class PipelineEngine:
 
         for idx, f in self._pre + self._post:
             for k, v in f.params.items():
-                name = f"l{idx}.{k}"
+                name = f.prefix + k
+                if name in flat:
+                    continue  # later occurrence of a tied (shared.*) layer
                 flat[name] = v
                 sp = user_spec(name, v.shape)
                 parts = list(sp) if sp is not None else [None] * v.ndim
                 parts += [None] * (v.ndim - len(parts))
                 specs[name] = P(*dp_extend(parts, v.shape))
             for k, v in f.buffers.items():
-                bufs[f"l{idx}.{k}"] = v
+                bufs.setdefault(f.prefix + k, v)
+
+        def stage_rows(get):
+            """Per-stage unit lists, mask-padding short stages with a copy
+            of their last real unit (inert under _stage_chunk's mask)."""
+            rows, off = [], 0
+            for c in self._stage_counts:
+                units = [get(f) for f in self._body[off:off + c]]
+                rows.append(units + [units[-1]] * (lb - c))
+                off += c
+            return rows
 
         for k in self._body[0].params:
-            stacked = jnp.stack([f.params[k] for f in self._body])
-            unit_shape = stacked.shape[1:]
-            stacked = stacked.reshape((S, lb) + unit_shape)
+            rows = stage_rows(lambda f: f.params[k])
+            stacked = jnp.stack([jnp.stack(r) for r in rows])  # [S, lb, ...]
+            unit_shape = stacked.shape[2:]
             name = f"seg.{k}"
             flat[name] = stacked
             sp = user_spec(name, unit_shape)
@@ -289,9 +342,8 @@ class PipelineEngine:
             parts = dp_extend(parts, unit_shape)
             specs[name] = P("pp", None, *parts)
         for k in self._body[0].buffers:
-            stacked = jnp.stack([f.buffers[k] for f in self._body])
-            bufs["seg." + k] = stacked.reshape(
-                (S, lb) + stacked.shape[1:])
+            rows = stage_rows(lambda f: f.buffers[k])
+            bufs["seg." + k] = jnp.stack([jnp.stack(r) for r in rows])
         return flat, specs, bufs
 
     def _sharding(self, spec):
@@ -353,8 +405,8 @@ class PipelineEngine:
             if f.fn is None:
                 vals = _as_tuple(_call_plain(f.layer, *vals))
             else:
-                out, _ = f.fn(self._sub_params(flat, f"l{idx}."),
-                              self._sub_params(self._bufs_dev, f"l{idx}."),
+                out, _ = f.fn(self._sub_params(flat, f.prefix),
+                              self._sub_params(self._bufs_dev, f.prefix),
                               jax.random.fold_in(key, idx), *vals)
                 vals = _as_tuple(out)
         return vals
@@ -368,19 +420,29 @@ class PipelineEngine:
         loss = self.loss_fn(t_out, *t_lab)
         return loss._data if isinstance(loss, Tensor) else loss
 
-    def _stage_chunk(self, seg_params, seg_bufs, key, h):
-        """One stage's chunk: scan over its units_per_stage body units."""
+    def _stage_chunk(self, seg_params, seg_bufs, key, h, valid=None):
+        """One stage's chunk: scan over its units_per_stage body units.
+        `valid` ([lb] bool, uneven segmentation only) masks out the padded
+        copy units: their output is discarded (h passes through) and their
+        cotangent is therefore zero."""
         unit = self._unit_fn
         keys = jax.random.split(key, self._units_per_stage)
 
         def body_fn(h, xs):
-            p, b, k = xs
+            if valid is None:
+                p, b, k = xs
+                out, _ = unit(p, b, k, h)
+                return out, None
+            p, b, k, v = xs
             out, _ = unit(p, b, k, h)
-            return out, None
+            return jnp.where(v, out, h), None
 
         if self.remat:
             body_fn = jax.checkpoint(body_fn)
-        h, _ = jax.lax.scan(body_fn, h, (seg_params, seg_bufs, keys))
+        xs = (seg_params, seg_bufs, keys)
+        if valid is not None:
+            xs = xs + (valid,)
+        h, _ = jax.lax.scan(body_fn, h, xs)
         return h
 
     def _pipeline_loss(self, flat, key, inputs, labels):
@@ -388,6 +450,8 @@ class PipelineEngine:
         M, S = self.micro_batches, self.pp
         seg_params = self._sub_params(flat, "seg.")
         seg_bufs = self._sub_params(self._bufs_dev, "seg.")
+        mask = (jnp.asarray(self._seg_mask)
+                if self._seg_mask is not None else None)
 
         pre_keys = jax.random.split(jax.random.fold_in(key, 0), M)
         h_in_all = jax.vmap(
@@ -413,8 +477,12 @@ class PipelineEngine:
             x = jnp.concatenate([incoming, x[:-1]], axis=0)
             x = jax.lax.with_sharding_constraint(x, x_spec)
             stage_keys = jax.random.split(k, S)
-            x = jax.vmap(self._stage_chunk)(seg_params, seg_bufs,
-                                            stage_keys, x)
+            if mask is None:
+                x = jax.vmap(self._stage_chunk)(seg_params, seg_bufs,
+                                                stage_keys, x)
+            else:
+                x = jax.vmap(self._stage_chunk)(seg_params, seg_bufs,
+                                                stage_keys, x, mask)
             x = jax.lax.with_sharding_constraint(x, x_spec)
             out_idx = jnp.clip(t - (S - 1), 0, M - 1)
             outs = jax.lax.dynamic_update_index_in_dim(

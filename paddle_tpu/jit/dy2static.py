@@ -1,0 +1,681 @@
+"""Dynamic-to-static control-flow capture (reference:
+`python/paddle/jit/dy2static/program_translator.py` +
+`jit/dy2static/transformers/ifelse_transformer.py`,
+`loop_transformer.py`, `logical_transformer.py`, and the converted-op
+runtime `convert_operators.py`).
+
+TPU-native design: the reference's AST transforms emit ProgramDesc
+`cond`/`while` block ops; ours emit calls into a tiny converted-op runtime
+that dispatches on *tracedness* —
+
+  - `if t:` with a traced (inside-jit) tensor predicate becomes
+    `lax.cond` over the branch-assigned variables;
+  - `while t:` becomes `lax.while_loop` with the body-assigned variables
+    as the loop carry;
+  - `a and b` / `a or b` / `not a` keep exact Python short-circuit
+    semantics for concrete values and become element-wise logical ops for
+    traced tensors;
+  - concrete (eager) predicates run the ordinary Python statement, so the
+    converted function is a drop-in replacement in BOTH eager and traced
+    execution — the same property the reference gets from running
+    converted programs through the dygraph-to-static executor.
+
+Conversion is best-effort: anything the transformer can't prove it can
+convert (returns buried mid-branch, `break`/`continue` in a converted
+loop, unavailable source) is left as ordinary Python, which either traces
+fine (concrete predicate) or trips jax's tracer-leak errors and degrades
+to the per-callable eager fallback in `StaticFunction.__call__`.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = [
+    "convert_function", "converted_layer_call", "convert_ifelse",
+    "convert_while", "convert_logical_and", "convert_logical_or",
+    "convert_logical_not", "Dy2StaticFallback",
+]
+
+_RUNTIME_NAME = "__pt_jst__"
+
+
+class Dy2StaticFallback(Exception):
+    """Raised by the converted-op runtime when a construct turns out to be
+    uncompilable at trace time (e.g. branch pytrees mismatch); the
+    StaticFunction catches it and degrades the callable to eager."""
+
+
+# --------------------------------------------------------------------------
+# converted-op runtime (reference convert_operators.py: convert_ifelse,
+# convert_while_loop, convert_logical_and/or/not)
+# --------------------------------------------------------------------------
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    return isinstance(_unwrap(x), jax.core.Tracer)
+
+
+def _pred_scalar(pred):
+    """Boolean scalar for lax control flow. Multi-element predicates are
+    ambiguous, same as Python's bool(ndarray)."""
+    p = _unwrap(pred)
+    p = jnp.asarray(p)
+    if p.size != 1:
+        raise Dy2StaticFallback(
+            "to_static: condition tensor must have exactly one element, got "
+            f"shape {p.shape} (reduce it with .all()/.any())")
+    return jnp.reshape(p.astype(bool), ())
+
+
+def _to_array_tree(x, what):
+    try:
+        return jax.tree.map(lambda v: jnp.asarray(_unwrap(v)), x,
+                            is_leaf=lambda v: isinstance(v, Tensor))
+    except (TypeError, ValueError) as e:
+        raise Dy2StaticFallback(
+            f"to_static: {what} produced a value that cannot live inside "
+            f"compiled control flow: {e}") from None
+
+
+def _to_tensor_tree(x):
+    return jax.tree.map(
+        lambda v: Tensor(v) if isinstance(v, jax.Array) else v, x)
+
+
+def convert_ifelse(pred, true_fn, false_fn, init=()):
+    """`if pred: <assigns>` -> the tuple of branch-assigned variables.
+    `init` carries the variables' pre-branch values in as branch-function
+    parameters (a name assigned inside a branch is local to the generated
+    function, so it cannot also be read through the closure).
+    Traced predicate: `lax.cond` (both branches traced, one executed on
+    device). Concrete predicate: plain Python — only the taken branch runs,
+    preserving eager semantics exactly."""
+    if not _is_traced(pred):
+        taken = true_fn if _truthy(pred) else false_fn
+        return taken(*init)
+    p = _pred_scalar(pred)
+    try:
+        out = jax.lax.cond(
+            p,
+            lambda _: _to_array_tree(true_fn(*init), "the true branch"),
+            lambda _: _to_array_tree(false_fn(*init), "the false branch"),
+            None)
+    except TypeError as e:
+        # branch output pytrees/shapes/dtypes disagree — uncompilable `if`
+        raise Dy2StaticFallback(
+            f"to_static: if/else branches returned mismatched values: {e}"
+        ) from None
+    return _to_tensor_tree(out)
+
+
+def convert_while(cond_fn, body_fn, init):
+    """`while cond: <body>` over the body-assigned loop variables.
+    Traced condition: `lax.while_loop` with the variables as carry (they
+    are fixed to their traced shapes/dtypes). Concrete: Python loop."""
+    first = cond_fn(*init)
+    if not _is_traced(first) and not any(_is_traced(v) for v in init):
+        state = tuple(init)
+        c = first
+        while _truthy(c):
+            state = tuple(body_fn(*state))
+            c = cond_fn(*state)
+        return state
+
+    arr_init = _to_array_tree(tuple(init), "the loop state")
+
+    def c_fn(s):
+        return _pred_scalar(cond_fn(*_to_tensor_tree(s)))
+
+    def b_fn(s):
+        out = tuple(body_fn(*_to_tensor_tree(s)))
+        out = _to_array_tree(out, "the loop body")
+        # loop variables may be pytrees (tuples/dicts of tensors) — compare
+        # structure and per-leaf shape/dtype, not top-level .shape
+        if jax.tree.structure(out) != jax.tree.structure(tuple(s)):
+            raise Dy2StaticFallback(
+                "to_static: while-loop variables changed structure across "
+                "an iteration; compiled loops need a stable carry")
+        for i, (a, b) in enumerate(zip(jax.tree.leaves(tuple(s)),
+                                       jax.tree.leaves(out))):
+            if a.shape != b.shape or a.dtype != b.dtype:
+                raise Dy2StaticFallback(
+                    "to_static: while-loop carry leaf "
+                    f"#{i} changed {a.shape}/{a.dtype} -> {b.shape}/{b.dtype}"
+                    " across an iteration; compiled loops need stable "
+                    "shapes/dtypes")
+        return out
+
+    try:
+        out = jax.lax.while_loop(c_fn, b_fn, arr_init)
+    except TypeError as e:
+        raise Dy2StaticFallback(
+            f"to_static: while loop is not compilable: {e}") from None
+    return _to_tensor_tree(out)
+
+
+class _Undef:
+    """Marker for a loop variable unbound before its loop (reference
+    dy2static UndefinedVar). Any use raises, like reading an unbound name."""
+
+    _INSTANCE = None
+
+    def __repr__(self):
+        return "<undefined local>"
+
+    def __bool__(self):
+        raise NameError("variable used before assignment in converted "
+                        "control flow")
+
+
+UNDEF = _Undef()
+_Undef._INSTANCE = UNDEF
+
+
+def lookup_or_undef(local_ns, name):
+    return local_ns.get(name, UNDEF)
+
+
+def _truthy(x):
+    return bool(_unwrap(x))
+
+
+def _logical(op, x, y):
+    a, b = jnp.asarray(_unwrap(x)), jnp.asarray(_unwrap(y))
+    out = {"and": jnp.logical_and, "or": jnp.logical_or}[op](
+        a.astype(bool), b.astype(bool))
+    return Tensor(out)
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    if _is_traced(x):
+        return _logical("and", x, y_fn())
+    if not _truthy(x):
+        return x  # short-circuit, y never evaluated — exact Python
+    return y_fn()
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if _is_traced(x):
+        return _logical("or", x, y_fn())
+    if _truthy(x):
+        return x
+    return y_fn()
+
+
+def convert_logical_not(x):
+    if _is_traced(x):
+        return Tensor(jnp.logical_not(jnp.asarray(_unwrap(x)).astype(bool)))
+    return not x
+
+
+# --------------------------------------------------------------------------
+# AST transformer (reference ifelse_transformer.py / loop_transformer.py)
+# --------------------------------------------------------------------------
+
+
+class _NameCollector(ast.NodeVisitor):
+    """Names assigned anywhere in a statement subtree, excluding nested
+    function/class scopes (their locals don't leak)."""
+
+    def __init__(self):
+        self.names = []
+        self._seen = set()
+
+    def _add(self, name):
+        if name not in self._seen:
+            self._seen.add(name)
+            self.names.append(name)
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self._add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+        elif isinstance(t, ast.Starred):
+            self._target(t.value)
+        # Attribute/Subscript stores mutate objects, not local bindings
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_withitem(self, node):
+        if node.optional_vars is not None:
+            self._target(node.optional_vars)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # def/class names are NOT collected as branch/loop state: function
+        # objects can't ride lax control flow, and the generated __pt_*
+        # helpers of already-converted inner constructs must stay local
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_ClassDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned_names(stmts):
+    c = _NameCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+class _CtlFlowFinder(ast.NodeVisitor):
+    """Detect Return/Raise at any depth, and Break/Continue belonging to
+    THIS loop level (not to a nested loop), within a statement list."""
+
+    def __init__(self):
+        self.has_return = False
+        self.has_break_continue = False
+        self.has_raise = False
+
+    def visit_Return(self, node):
+        self.has_return = True
+
+    def visit_Raise(self, node):
+        # a converted branch is TRACED even when untaken — a data-dependent
+        # guard (`if bad: raise`) must stay Python so it degrades to eager
+        # instead of raising spuriously at trace time
+        self.has_raise = True
+
+    def visit_Break(self, node):
+        self.has_break_continue = True
+
+    def visit_Continue(self, node):
+        self.has_break_continue = True
+
+    def visit_For(self, node):
+        # break/continue inside a nested loop bind to it — only returns leak
+        for s in node.body + node.orelse:
+            _ReturnOnly.check(s, self)
+
+    def visit_While(self, node):
+        for s in node.body + node.orelse:
+            _ReturnOnly.check(s, self)
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+class _ReturnOnly(ast.NodeVisitor):
+    def __init__(self, sink):
+        self.sink = sink
+
+    @staticmethod
+    def check(stmt, sink):
+        _ReturnOnly(sink).visit(stmt)
+
+    def visit_Return(self, node):
+        self.sink.has_return = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _ctlflow(stmts):
+    f = _CtlFlowFinder()
+    for s in stmts:
+        f.visit(s)
+    return f
+
+
+def _name(id_, ctx):
+    return ast.Name(id=id_, ctx=ctx)
+
+
+def _fn_def(name, args, body):
+    fd = ast.FunctionDef(name=name, args=args, body=body,
+                         decorator_list=[], returns=None, type_comment=None)
+    if hasattr(fd, "type_params"):  # 3.12+
+        fd.type_params = []
+    return fd
+
+
+def _runtime_attr(fn_name):
+    return ast.Attribute(value=_name(_RUNTIME_NAME, ast.Load()),
+                         attr=fn_name, ctx=ast.Load())
+
+
+def _names_tuple(names, ctx):
+    return ast.Tuple(elts=[_name(n, ctx) for n in names], ctx=ctx)
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites if/while/bool-ops into converted-op runtime calls."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- statement-list processing with `if c: return x` folding ------------
+    def _process_block(self, stmts):
+        out = []
+        i = 0
+        while i < len(stmts):
+            s = stmts[i]
+            rest = stmts[i + 1:]
+            if (isinstance(s, ast.If) and not s.orelse
+                    and s.body and isinstance(s.body[-1], ast.Return)):
+                # `if c: ...; return x` followed by <rest> is exactly
+                # `if c: ...; return x / else: <rest>` (and an implicit
+                # `return None` when nothing follows) — fold so the
+                # two-sided return rewrite below can fire
+                orelse = list(rest) if rest \
+                    else [ast.Return(value=ast.Constant(value=None))]
+                folded = ast.If(test=s.test, body=s.body, orelse=orelse)
+                out.extend(self._process_stmt(folded))
+                return out
+            out.extend(self._process_stmt(s))
+            i += 1
+        return out
+
+    def _process_stmt(self, s):
+        r = self.visit(s)
+        if r is None:
+            return []
+        return r if isinstance(r, list) else [r]
+
+    def visit_FunctionDef(self, node):
+        node.args = self.visit(node.args)
+        node.body = self._process_block(node.body)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- if ------------------------------------------------------------------
+    def visit_If(self, node):
+        node.test = self.visit(node.test)
+        node.body = self._process_block(node.body)
+        node.orelse = self._process_block(node.orelse)
+
+        body_f = _ctlflow(node.body)
+        else_f = _ctlflow(node.orelse)
+
+        # two-sided single-return: `if c: return A else: return B`
+        if (len(node.body) == 1 and isinstance(node.body[0], ast.Return)
+                and len(node.orelse) == 1
+                and isinstance(node.orelse[0], ast.Return)):
+            a = node.body[0].value or ast.Constant(value=None)
+            b = node.orelse[0].value or ast.Constant(value=None)
+            call = ast.Call(
+                func=_runtime_attr("convert_ifelse"),
+                args=[node.test,
+                      ast.Lambda(args=_empty_args(), body=a),
+                      ast.Lambda(args=_empty_args(), body=b)],
+                keywords=[])
+            return ast.Return(value=call)
+
+        if body_f.has_return or else_f.has_return:
+            return node  # mid-branch returns: leave as Python
+        if body_f.has_raise or else_f.has_raise:
+            return node  # raising guards: leave as Python (eager fallback)
+        if body_f.has_break_continue or else_f.has_break_continue:
+            return node  # break/continue belong to an enclosing loop
+
+        names = _assigned_names(node.body + node.orelse)
+        uid = self._uid()
+        tname, fname = f"__pt_true_{uid}", f"__pt_false_{uid}"
+        # branch-assigned names come IN as parameters: a name assigned in a
+        # branch is local to the generated function, so its pre-branch value
+        # cannot be read through the closure
+        args = _params(names)
+        ret = ast.Return(value=_names_tuple(names, ast.Load()))
+        tdef = _fn_def(tname, args,
+                       (node.body or [ast.Pass()]) + [ret])
+        fdef = _fn_def(fname, _copy_args(args),
+                       (node.orelse or [ast.Pass()]) + [_copy_ret(ret)])
+        call = ast.Call(
+            func=_runtime_attr("convert_ifelse"),
+            args=[node.test, _name(tname, ast.Load()),
+                  _name(fname, ast.Load()),
+                  _names_tuple(names, ast.Load())],
+            keywords=[])
+        if names:
+            assign = ast.Assign(targets=[_names_tuple(names, ast.Store())],
+                                value=call)
+        else:
+            assign = ast.Expr(value=call)
+        return [tdef, fdef] + _undef_guards(names) + [assign]
+
+    # -- while ---------------------------------------------------------------
+    def visit_While(self, node):
+        node.test = self.visit(node.test)
+        node.body = self._process_block(node.body)
+        node.orelse = self._process_block(node.orelse)
+
+        f = _ctlflow(node.body)
+        if f.has_return or f.has_break_continue or f.has_raise or node.orelse:
+            return node
+        names = _assigned_names(node.body)
+        if not names:
+            return node  # side-effect-only loop: nothing to carry
+
+        uid = self._uid()
+        cname, bname = f"__pt_cond_{uid}", f"__pt_body_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None,
+            defaults=[])
+        cdef = _fn_def(cname, args, [ast.Return(value=node.test)])
+        bdef = _fn_def(bname, _copy_args(args),
+                       node.body + [ast.Return(value=_names_tuple(
+                           names, ast.Load()))])
+        guards = _undef_guards(names)
+        call = ast.Call(
+            func=_runtime_attr("convert_while"),
+            args=[_name(cname, ast.Load()), _name(bname, ast.Load()),
+                  _names_tuple(names, ast.Load())],
+            keywords=[])
+        assign = ast.Assign(targets=[_names_tuple(names, ast.Store())],
+                            value=call)
+        return [cdef, bdef] + guards + [assign]
+
+    # -- bool ops ------------------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        # fold left-assoc: a and b and c -> and(and(a, b), c), each operand
+        # thunked to keep short-circuit evaluation for concrete values
+        expr = node.values[0]
+        for v in node.values[1:]:
+            expr = ast.Call(
+                func=_runtime_attr(fn),
+                args=[ast.Lambda(args=_empty_args(), body=expr),
+                      ast.Lambda(args=_empty_args(), body=v)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=_runtime_attr("convert_logical_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _params(names):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n) for n in names], vararg=None,
+        kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _undef_guards(names):
+    """`name = lookup_or_undef(locals(), 'name')` per name: a variable
+    assigned only inside the construct may be unbound before it; bind it to
+    the UNDEF marker so building the initial-state tuple doesn't
+    UnboundLocalError (Python semantics preserved — reading UNDEF fails
+    just like reading an unbound name)."""
+    return [
+        ast.Assign(
+            targets=[_name(n, ast.Store())],
+            value=ast.Call(
+                func=_runtime_attr("lookup_or_undef"),
+                args=[ast.Call(func=_name("locals", ast.Load()),
+                               args=[], keywords=[]),
+                      ast.Constant(value=n)],
+                keywords=[]))
+        for n in names
+    ]
+
+
+def _copy_args(a):
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=x.arg) for x in a.args],
+        vararg=None, kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _copy_ret(r):
+    return ast.Return(value=ast.copy_location(
+        _names_tuple([e.id for e in r.value.elts], ast.Load()), r.value))
+
+
+# --------------------------------------------------------------------------
+# function conversion
+# --------------------------------------------------------------------------
+
+_CACHE_ATTR = "__pt_dy2static_converted__"
+
+
+def convert_function(fn):
+    """Best-effort AST conversion of `fn`. Returns the converted function,
+    or `fn` unchanged when source is unavailable or conversion fails.
+    The converted function is a drop-in replacement in eager execution
+    (concrete predicates take the Python path of the converted ops)."""
+    cached = getattr(fn, _CACHE_ATTR, None)
+    if cached is not None:
+        # the cache lives on the underlying function (shared across
+        # instances for methods) — rebind to THIS instance on a hit
+        if isinstance(fn, types.MethodType):
+            return types.MethodType(cached, fn.__self__)
+        return cached
+    raw = fn.__func__ if isinstance(fn, types.MethodType) else fn
+    if hasattr(raw, "__wrapped__"):
+        # functools.wraps-style wrapper: getsource would unwrap to the
+        # ORIGINAL def and conversion would silently drop the wrapper's
+        # behavior — leave it alone (the wrapped inner fn still traces,
+        # and genuinely dynamic control flow degrades to eager)
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return fn
+        if fdef.name != raw.__name__:
+            return fn  # source doesn't correspond to this function
+        fdef.decorator_list = []  # don't re-apply @to_static and friends
+        new_tree = ControlFlowTransformer().visit(tree)
+        ast.fix_missing_locations(new_tree)
+        ns = dict(raw.__globals__)
+        from paddle_tpu.jit import dy2static as _rt
+
+        ns[_RUNTIME_NAME] = _rt
+        filename = f"<dy2static {raw.__code__.co_filename}>"
+        free = raw.__code__.co_freevars
+        if free:
+            # Re-bind the ORIGINAL closure cells so later nonlocal updates
+            # stay visible: compile the converted def nested in a factory
+            # (making the free names real freevars of the new code object),
+            # then rebuild the function over raw.__closure__.
+            factory = _fn_def("__pt_factory__", _params(list(free)),
+                              [new_tree.body[0],
+                               ast.Return(value=_name(fdef.name,
+                                                      ast.Load()))])
+            mod = ast.Module(body=[factory], type_ignores=[])
+            ast.fix_missing_locations(mod)
+            exec(compile(mod, filename, "exec"), ns)
+            probe = ns["__pt_factory__"](*([None] * len(free)))
+            if probe.__code__.co_freevars != free:
+                return fn  # conversion changed the free-variable set
+            new_fn = types.FunctionType(
+                probe.__code__, ns, raw.__name__, raw.__defaults__,
+                raw.__closure__)
+            new_fn.__kwdefaults__ = raw.__kwdefaults__
+        else:
+            exec(compile(new_tree, filename, "exec"), ns)
+            new_fn = ns[fdef.name]
+        functools.update_wrapper(new_fn, raw,
+                                 assigned=("__name__", "__doc__",
+                                           "__qualname__"), updated=())
+        del new_fn.__wrapped__  # set by update_wrapper; see bail-out above
+    except (OSError, TypeError, SyntaxError, ValueError, IndentationError,
+            AttributeError, KeyError):
+        return fn
+    try:
+        setattr(raw, _CACHE_ATTR, new_fn)
+    except (AttributeError, TypeError):
+        pass
+    if isinstance(fn, types.MethodType):
+        return types.MethodType(new_fn, fn.__self__)
+    return new_fn
+
+
+def converted_layer_call(layer):
+    """A callable equivalent to `layer.__call__` but running the dy2static-
+    converted `forward` (pre/post forward hooks preserved via the shared
+    Layer._call_with_forward dispatch)."""
+    conv_fwd = convert_function(layer.forward)
+
+    def call(*inputs, **kwargs):
+        return layer._call_with_forward(conv_fwd, *inputs, **kwargs)
+
+    return call

@@ -28,6 +28,12 @@ from paddle_tpu.core.tensor import Tensor, apply
 from paddle_tpu.nn import functional as F
 import importlib
 
+try:  # private API; if it moves, conservatively assume "always tracing"
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except Exception:  # pragma: no cover - jax upgrade path
+    def _trace_state_clean():
+        return False  # never cache device tables (recompute is safe)
+
 flash_attn_mod = importlib.import_module("paddle_tpu.nn.functional.flash_attention")
 
 __all__ = [
@@ -138,6 +144,13 @@ class LlamaRotaryEmbedding(nn.Layer):
         self._cache = {}  # seq_len -> (cos Tensor, sin Tensor), float32
 
     def forward(self, seq_len):
+        if not _trace_state_clean():
+            # under jit/export tracing: recompute (XLA folds/fuses the
+            # tables). Caching here would close later traces over a large
+            # device-array constant, which export lifts into an extra
+            # argument and breaks the saved program's input tree.
+            cos, sin = _rope_tables(seq_len, self.head_dim, self.theta)
+            return Tensor(cos), Tensor(sin)
         if seq_len not in self._cache:
             cos, sin = _rope_tables(seq_len, self.head_dim, self.theta)
             self._cache[seq_len] = (Tensor(cos), Tensor(sin))

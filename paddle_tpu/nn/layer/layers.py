@@ -252,11 +252,16 @@ class Layer:
 
     # -- call ---------------------------------------------------------------
     def __call__(self, *inputs, **kwargs):
+        return self._call_with_forward(self.forward, *inputs, **kwargs)
+
+    def _call_with_forward(self, forward, *inputs, **kwargs):
+        """__call__ semantics over an arbitrary forward implementation
+        (dy2static substitutes a converted forward; hooks stay in force)."""
         for hook in self._forward_pre_hooks.values():
             out = hook(self, inputs)
             if out is not None:
                 inputs = out if isinstance(out, tuple) else (out,)
-        outputs = self.forward(*inputs, **kwargs)
+        outputs = forward(*inputs, **kwargs)
         for hook in self._forward_post_hooks.values():
             res = hook(self, inputs, outputs)
             if res is not None:

@@ -193,14 +193,176 @@ def test_body_detection_and_errors():
         def forward(self, x):
             return paddle.tanh(self.fc(x))
 
-    # 5 identical blocks, pp=2 -> front-trimmed to 4 (first joins pre)
+    # 5 identical blocks, pp=2 -> uneven cut 3/2 with one masked pad slot
+    # (pre-r4 this trimmed the first block into the pre segment)
     pipe = PipelineLayer(layers=[LayerDesc(Block, 8) for _ in range(5)],
                          num_stages=2, loss_fn=lambda o, l: paddle.mean(o))
     eng = PipelineEngine(pipe, pp=2, dp=1, mp=1)
-    assert len(eng._pre) == 1 and len(eng._body) == 4
+    assert len(eng._pre) == 0 and len(eng._body) == 5
+    assert eng._stage_counts == [3, 2] and eng._units_per_stage == 3
 
     with pytest.raises(ValueError, match="homogeneous"):
         PipelineEngine(
             PipelineLayer(layers=[LayerDesc(Block, 8)], num_stages=2,
                           loss_fn=lambda o, l: paddle.mean(o)),
             pp=2, dp=1, mp=1)
+
+
+# --------------------------------------------------------------------------
+# SharedLayerDesc weight tying (VERDICT r3 item 4)
+# --------------------------------------------------------------------------
+
+class _TiedEmbed(nn.Layer):
+    """Input-embedding layer whose weight is also the output projection
+    (the reference's tied-embedding idiom, pp_layers.py:77)."""
+
+    def __init__(self, vocab, hidden):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, hidden)
+
+    def forward(self, ids):
+        return self.emb(ids)
+
+
+def _tied_head_fwd(layer, h):
+    # logits through the SAME embedding weight (transposed)
+    return paddle.matmul(h, layer.emb.weight, transpose_y=True)
+
+
+class _CELoss(nn.Layer):
+    def forward(self, logits, labels):
+        import paddle_tpu.nn.functional as F
+
+        return F.cross_entropy(
+            logits.reshape([-1, logits.shape[-1]]), labels.reshape([-1]))
+
+
+def _tied_lm(pp, hidden=32, vocab=128, n_layers=4):
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        SharedLayerDesc)
+
+    descs = [SharedLayerDesc("embed", _TiedEmbed, None, "emb.weight",
+                             vocab, hidden)]
+    descs += [LayerDesc(nn.TransformerEncoderLayer, d_model=hidden,
+                        nhead=4, dim_feedforward=64, dropout=0.0,
+                        activation="gelu")
+              for _ in range(n_layers)]
+    descs.append(SharedLayerDesc("embed", _TiedEmbed, _tied_head_fwd,
+                                 "emb.weight", vocab, hidden))
+    return PipelineLayer(layers=descs, num_stages=pp, loss_fn=_CELoss())
+
+
+def test_shared_layer_desc_tied_embedding_parity():
+    """Tied-embedding LM at pp=2: loss parity vs single-device eager of the
+    same PipelineLayer (which ties by construction — same layer object),
+    and the tied grad equals the SUM of both occurrences' cotangents."""
+    pp, M = 2, 2
+    pipe = _tied_lm(pp)
+    rng = np.random.default_rng(0)
+    B, s = 4, 16
+    ids = rng.integers(0, 128, (B, s)).astype(np.int32)
+    labels = rng.integers(0, 128, (B, s)).astype(np.int64)
+
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=pipe.parameters())
+    eng = PipelineEngine(pipe, optimizer=opt, dp=1, pp=pp, mp=1,
+                         micro_batches=M)
+    loss, grads = eng.loss_and_grads([ids], [labels])
+    ref = _eager_ref_loss(pipe, _CELoss(), [ids], [labels], M)
+    np.testing.assert_allclose(float(loss), ref, rtol=2e-4)
+
+    # tied param surfaces once in the flat tree
+    tied = [k for k in grads if k.startswith("shared.embed.")]
+    assert "shared.embed.emb.weight" in tied, sorted(grads)
+
+    # reference tied grad: functionalize the whole pipe (the shared layer's
+    # Parameter object is swapped once, so AD sums both uses) and sum any
+    # duplicate-name entries pointing at the embedding weight
+    ref_loss, ref_grads = _ref_grads(eng, pipe, _CELoss(), [ids], [labels])
+    ref_tied = None
+    for name, g in ref_grads.items():
+        if name.endswith("emb.weight"):
+            ref_tied = g if ref_tied is None else ref_tied + g
+    np.testing.assert_allclose(
+        np.asarray(grads["shared.embed.emb.weight"]), np.asarray(ref_tied),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_shared_layer_desc_trains():
+    """Tied model actually trains through train_batch (loss decreases)."""
+    pipe = _tied_lm(2)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=pipe.parameters())
+    eng = PipelineEngine(pipe, optimizer=opt, dp=2, pp=2, mp=1,
+                         micro_batches=2)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 128, (8, 16)).astype(np.int32)
+    labels = ids.astype(np.int64)  # learn the identity map
+    losses = [float(eng.train_batch([ids], [labels])) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+# --------------------------------------------------------------------------
+# Uneven pipeline segmentation (VERDICT r3 item 10)
+# --------------------------------------------------------------------------
+
+def test_uneven_body_10_layers_pp4_parity():
+    """10-layer homogeneous body at pp=4 (stage unit counts 3/3/2/2 via
+    mask padding): loss AND grads match single-device eager — the
+    reference's seg_method uneven-cut capability (pp_layers.py:264)."""
+    cfg = BertConfig(vocab_size=256, hidden_size=32, num_hidden_layers=10,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0)
+    pipe = PipelineLayer(layers=bert_pipeline_descs(cfg), num_stages=4,
+                         loss_fn=BertMLMLoss())
+    rng = np.random.default_rng(0)
+    B = 4
+    ids = rng.integers(0, cfg.vocab_size, (B, 16)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (B, 16)).astype(np.int64)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=pipe.parameters())
+    eng = PipelineEngine(pipe, optimizer=opt, dp=1, pp=4, mp=1,
+                         micro_batches=2)
+    assert eng._stage_counts == [3, 3, 2, 2]
+    assert eng._units_per_stage == 3
+    loss, grads = eng.loss_and_grads([ids], [labels])
+    ref = _eager_ref_loss(pipe, BertMLMLoss(), [ids], [labels], 2)
+    np.testing.assert_allclose(float(loss), ref, rtol=2e-4)
+
+    # grad parity incl. zero grads at the padded slots
+    ref_loss, ref_grads = _ref_grads(eng, pipe, BertMLMLoss(),
+                                     [ids], [labels])
+    n_pre = len(eng._pre)
+    S, lb = eng.pp, eng._units_per_stage
+    for k in [k for k in grads if k.startswith("seg.")]:
+        key = k[len("seg."):]
+        per_layer = [ref_grads[f"_built_layers.{n_pre + i}.{key}"]
+                     for i in range(10)]
+        expect = np.zeros((S, lb) + np.asarray(per_layer[0]).shape,
+                          np.asarray(per_layer[0]).dtype)
+        off = 0
+        for s2, c in enumerate(eng._stage_counts):
+            for u in range(c):
+                expect[s2, u] = np.asarray(per_layer[off + u])
+            off += c
+        np.testing.assert_allclose(np.asarray(grads[k]), expect,
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_uneven_body_trains():
+    """Uneven cut end-to-end through train_batch with dp+mp composed."""
+    cfg = BertConfig(vocab_size=256, hidden_size=32, num_hidden_layers=5,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=64, hidden_dropout_prob=0.0)
+    pipe = PipelineLayer(layers=bert_pipeline_descs(cfg), num_stages=2,
+                         loss_fn=BertMLMLoss())
+    opt = paddle.optimizer.AdamW(learning_rate=2e-3,
+                                 parameters=pipe.parameters())
+    eng = PipelineEngine(pipe, optimizer=opt, dp=2, pp=2, mp=2,
+                         micro_batches=2, mp_spec_fn=transformer_mp_spec)
+    assert eng._stage_counts == [3, 2]
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int64)
+    losses = [float(eng.train_batch([ids], [labels])) for _ in range(6)]
+    assert losses[-1] < losses[0], losses

@@ -583,3 +583,53 @@ def test_metadata_merge_empty_shards_do_not_clobber(tmp_path):
     merged = Metadata.load_dir(str(tmp_path))
     assert merged.tensors["w"].shards, "empty entry clobbered real shards"
     assert merged.tensors["w"].shards[0].file == "w.0.npy"
+
+
+def test_weight_only_int8_predictor(tmp_path):
+    """Weight-only int8 inference (VERDICT r3 item 5): jit.save(...,
+    quantize='weight_only_int8') stores 2-D matmul weights int8 + scale,
+    the exported program dequantizes inline, the Predictor runs it with no
+    special mode, and accuracy stays within weight-only error bounds
+    (reference: PaddleSlim save_quantized_model -> analysis_predictor
+    quant passes)."""
+    import pickle
+
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    m = nn.Sequential(nn.Linear(64, 128), nn.GELU(), nn.Linear(128, 128),
+                      nn.GELU(), nn.Linear(128, 32))
+    x = np.random.default_rng(0).normal(size=(4, 64)).astype("float32")
+    ref = m(paddle.to_tensor(x)).numpy()
+
+    fp = str(tmp_path / "fp32")
+    q8 = str(tmp_path / "int8")
+    spec = [InputSpec([None, 64], "float32", "x")]
+    paddle.jit.save(m, fp, input_spec=spec)
+    paddle.jit.save(m, q8, input_spec=spec, quantize="weight_only_int8")
+
+    with open(q8 + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["quantize"] == "weight_only_int8"
+    assert len(meta["quantized_keys"]) == 3  # the three Linear weights
+    with open(q8 + ".pdiparams", "rb") as f:
+        qstate = pickle.load(f)
+    for k in meta["quantized_keys"]:
+        assert qstate[k].dtype == np.int8
+        assert qstate[k + ".__scale__"].dtype == np.float32
+    import os
+
+    # int8 weights shrink the params file (biases/scales stay f32)
+    assert os.path.getsize(q8 + ".pdiparams") < \
+        0.5 * os.path.getsize(fp + ".pdiparams")
+
+    for prefix in (fp, q8):
+        cfg = Config(prefix)
+        cfg.disable_gpu()
+        out = create_predictor(cfg).run([x])[0]
+        if prefix == fp:
+            np.testing.assert_allclose(out, ref, atol=1e-5)
+        else:
+            # weight-only int8: per-channel 8-bit rounding error only
+            err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+            assert err < 0.05, f"int8 relative error {err:.4f}"
