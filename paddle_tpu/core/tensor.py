@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 from collections import deque
 
 import jax
@@ -267,6 +268,29 @@ class Tensor:
         self._data = jnp.full_like(self._data, value)
         return self
 
+    # -- in-place RNG refills (reference gaussian_inplace / uniform_inplace
+    #    / exponential_ kernels) -------------------------------------------
+    def normal_(self, mean=0.0, std=1.0):
+        from paddle_tpu.framework import random as _rng
+
+        self._data = (mean + std * jax.random.normal(
+            _rng.next_key(), self._data.shape)).astype(self._data.dtype)
+        return self
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0):
+        from paddle_tpu.framework import random as _rng
+
+        key = jax.random.key(seed) if seed else _rng.next_key()
+        self._data = jax.random.uniform(
+            key, self._data.shape, minval=min,
+            maxval=max).astype(self._data.dtype)
+        return self
+
+    def exponential_(self, lam=1.0):
+        from paddle_tpu.ops.creation import exponential_ as _exp
+
+        return _exp(self, lam)
+
     def register_hook(self, hook):
         # grad hooks live in the backward engine's weak table
         from paddle_tpu.core.backward import register_tensor_hook
@@ -362,6 +386,7 @@ def _as_data(x):
 # checking every kernel output, eager/nan_inf_utils.cc). None when off —
 # installed by paddle_tpu.amp.debugging so the hot path pays one None-check.
 _sanitizer = None
+_op_tracer = None  # profiler hook: fn(op_name, host_seconds) on the waist
 
 
 def apply(fn, *tensors, _name="op", _nout=None):
@@ -382,10 +407,17 @@ def apply(fn, *tensors, _name="op", _nout=None):
     needs_grad = is_grad_enabled() and any(
         (not t.stop_gradient) and _is_float_dtype(t.dtype) for t in tensors
     )
+    tracer = _op_tracer
+    t0 = time.perf_counter() if tracer is not None else 0.0
     if needs_grad:
         out, vjp_fn = jax.vjp(fn, *datas)
     else:
         out = fn(*datas)
+    if tracer is not None:
+        # host dispatch time per op (the reference host tracer's RecordEvent
+        # bracket in every generated api, api_base.py:1356); device time
+        # lives in the xprof trace
+        tracer(_name, time.perf_counter() - t0)
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]

@@ -2,3 +2,40 @@
 
 from paddle_tpu.incubate import nn  # noqa: F401
 from paddle_tpu.incubate import distributed  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (reference
+    `python/paddle/incubate/operators/softmax_mask_fuse.py` /
+    `phi/kernels/fused_softmax_mask_kernel`) — on TPU the add+softmax
+    fuses in XLA; this is the same public op surface."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import apply
+
+    def fn(a, m):
+        return jax.nn.softmax((a.astype(jnp.float32)
+                               + m.astype(jnp.float32)), axis=-1).astype(
+            a.dtype)
+
+    return apply(fn, x, mask, _name="fused_softmax_mask")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Fused causal softmax (reference
+    `incubate/operators/softmax_mask_fuse_upper_triangle.py`): softmax of
+    x with the upper triangle (future positions) masked out.
+    x: [..., s_q, s_k]."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import apply
+
+    def fn(a):
+        sq, sk = a.shape[-2], a.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool))
+        z = jnp.where(causal, a.astype(jnp.float32), -jnp.inf)
+        return jax.nn.softmax(z, axis=-1).astype(a.dtype)
+
+    return apply(fn, x, _name="fused_softmax_mask_upper_triangle")

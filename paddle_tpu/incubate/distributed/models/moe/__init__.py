@@ -2,3 +2,8 @@ from paddle_tpu.incubate.distributed.models.moe.moe_layer import (  # noqa: F401
     MoELayer,
 )
 from paddle_tpu.incubate.distributed.models.moe import gate  # noqa: F401
+from paddle_tpu.incubate.distributed.models.moe import utils  # noqa: F401
+from paddle_tpu.incubate.distributed.models.moe.utils import (  # noqa: F401
+    assign_pos, limit_by_capacity, number_count, prune_gate_by_capacity,
+    random_routing,
+)

@@ -1,0 +1,85 @@
+"""MoE routing utility ops (reference
+`python/paddle/incubate/distributed/models/moe/utils.py` +
+`phi/kernels/number_count_kernel / assign_pos_kernel /
+limit_by_capacity_kernel / prune_gate_by_capacity_kernel /
+random_routing_kernel`): the small integer ops around gate dispatch,
+implemented as pure jnp (static shapes; sort-based assign_pos)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["number_count", "assign_pos", "limit_by_capacity",
+           "prune_gate_by_capacity", "random_routing"]
+
+
+def _d(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def number_count(gate_idx, upper_range):
+    """Tokens per expert: histogram of gate_idx over [0, upper_range)."""
+    g = _d(gate_idx).reshape(-1)
+    counts = jnp.sum(jax.nn.one_hot(g, upper_range, dtype=jnp.int64), axis=0)
+    return Tensor(counts)
+
+
+def assign_pos(gate_idx, cum_count):
+    """Token positions grouped by expert: pos[k] = index of the k-th token
+    in expert-sorted order (reference assign_pos_kernel; stable sort is
+    the TPU-friendly equivalent of its atomic slot grab)."""
+    g = _d(gate_idx).reshape(-1)
+    order = jnp.argsort(g, stable=True)
+    return Tensor(order.astype(jnp.int64))
+
+
+def limit_by_capacity(expert_count, capacity, n_worker=1):
+    """Clamp per-expert token counts to capacity (reference
+    limit_by_capacity_kernel). expert_count: [n_worker * n_expert] or
+    [n_expert]; capacity: [n_expert] per-expert budget shared by workers."""
+    ec = _d(expert_count)
+    cap = _d(capacity)
+    e = cap.shape[0]
+    ecw = ec.reshape(-1, e)
+
+    def worker_pass(cap_left, row):
+        take = jnp.minimum(row, jnp.maximum(cap_left, 0))
+        return cap_left - take, take
+
+    _, taken = jax.lax.scan(worker_pass, cap, ecw)
+    return Tensor(taken.reshape(ec.shape).astype(ec.dtype))
+
+
+def prune_gate_by_capacity(gate_idx, expert_count, n_expert=None,
+                           n_worker=1):
+    """Set overflowed tokens' expert to -1 (reference
+    prune_gate_by_capacity_kernel): the k-th token routed to expert e
+    survives iff k < expert_count[e] (post-limit)."""
+    g = _d(gate_idx).reshape(-1)
+    ec = _d(expert_count).reshape(-1)
+    e = ec.shape[0]
+    onehot = jax.nn.one_hot(g, e, dtype=jnp.int32)
+    rank_within = jnp.cumsum(onehot, axis=0) * onehot  # 1-based rank
+    k = jnp.sum(rank_within, axis=1)
+    keep = k <= ec[jnp.clip(g, 0, e - 1)]
+    return Tensor(jnp.where(keep, g, -1).astype(_d(gate_idx).dtype).reshape(
+        _d(gate_idx).shape))
+
+
+def random_routing(topk_idx, topk_value, prob, topk=2):
+    """Stochastic second-choice drop (reference random_routing_kernel):
+    keep the 2nd expert only when prob < 2 * its gate value; else -1."""
+    idx = _d(topk_idx)
+    val = _d(topk_value)
+    p = _d(prob).reshape(-1)
+    if topk != 2:
+        raise ValueError("random_routing supports topk=2 (reference parity)")
+    iv = idx.reshape(-1, topk)
+    vv = val.reshape(-1, topk)
+    keep2 = p < (2.0 * vv[:, 1])
+    second = jnp.where(keep2, iv[:, 1], -1)
+    out = jnp.stack([iv[:, 0], second], axis=1)
+    return Tensor(out.reshape(idx.shape).astype(idx.dtype))

@@ -375,3 +375,67 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 sample_at(x1, y1) * wd[:, None])
 
     return apply(fn, x, grid, _name="grid_sample")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """mask[i.., j] = j < x[i..] (reference `python/paddle/nn/functional/
+    extension.py` sequence_mask / `phi/kernels/sequence_mask_kernel`).
+    maxlen=None uses x.max() — eager only (data-dependent shape); pass a
+    static maxlen under jit."""
+    from paddle_tpu.framework import dtypes as _dt
+
+    lens = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(lens))
+    rng = jnp.arange(int(maxlen))
+    mask = rng[None, :] < lens.reshape(-1, 1)
+    mask = mask.reshape(tuple(lens.shape) + (int(maxlen),))
+    return Tensor(mask.astype(_dt.convert_dtype(dtype)))
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (reference `python/paddle/nn/functional/
+    extension.py` temporal_shift / `phi/kernels/temporal_shift_kernel`):
+    the first shift_ratio of channels shifts t-1, the second t+1, the rest
+    stay. x: [N*T, C, H, W]."""
+    def fn(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.pad(v, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        fwd = pad[:, :seg_num, :c1]        # channel block shifted from t-1
+        bwd = pad[:, 2:, c1:c2]            # shifted from t+1
+        keep = v[:, :, c2:]
+        out = jnp.concatenate([fwd, bwd, keep], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply(fn, x, _name="temporal_shift")
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrack (reference `python/paddle/nn/functional/
+    extension.py` gather_tree / `phi/kernels/gather_tree_kernel`): walk
+    parent pointers from the last step so each beam holds its full
+    ancestry. ids/parents: [T, batch, beam]."""
+    def fn(idv, par):
+        T = idv.shape[0]
+        beams = jnp.arange(idv.shape[2])[None, :]
+        beams = jnp.broadcast_to(beams, idv.shape[1:])
+
+        def step(carry, t):
+            beam = carry
+            tok = jnp.take_along_axis(idv[t], beam, axis=-1)
+            beam = jnp.take_along_axis(par[t], beam, axis=-1)
+            return beam, tok
+
+        _, toks = jax.lax.scan(step, beams, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return apply(fn, ids, parents, _name="gather_tree")

@@ -83,8 +83,82 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax
     return out, None
 
 
-def flash_attn_unpadded(*args, **kwargs):
-    raise NotImplementedError("varlen flash attention: use dense + mask on TPU")
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """Packed-QKV flash attention (reference
+    `nn/functional/flash_attention.py` flash_attn_qkvpacked): qkv is
+    [b, s, 3, h, d]; unpack and run the same kernel."""
+    from paddle_tpu.ops.manipulation import squeeze, split
+
+    q, k, v = split(qkv, 3, axis=2)
+    q, k, v = (squeeze(t, axis=2) for t in (q, k, v))
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax, training=training)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen flash attention (reference flash_attn_unpadded): tokens of
+    all sequences packed along dim 0 with cu_seqlens boundaries. TPU path:
+    re-pad to [nseq, max_seqlen] dense batches with a validity mask (XLA
+    wants static shapes; the padded FLOPs are masked out of the result),
+    run masked SDPA, then re-pack. Routed through apply() so autograd
+    flows into q/k/v. Eager-only (data-dependent shapes)."""
+    import numpy as np
+
+    cq = np.asarray(cu_seqlens_q.numpy()
+                    if isinstance(cu_seqlens_q, Tensor) else cu_seqlens_q)
+    ck = np.asarray(cu_seqlens_k.numpy()
+                    if isinstance(cu_seqlens_k, Tensor) else cu_seqlens_k)
+    nseq = len(cq) - 1
+    mq, mk = int(max_seqlen_q), int(max_seqlen_k)
+
+    def fn(qa, ka, va):
+        def pad_batch(a, cu, m):
+            h, d = a.shape[1], a.shape[2]
+            out = jnp.zeros((nseq, m, h, d), a.dtype)
+            for i in range(nseq):
+                ln = int(cu[i + 1] - cu[i])
+                out = out.at[i, :ln].set(a[int(cu[i]):int(cu[i + 1])])
+            return out
+
+        qb = pad_batch(qa, cq, mq)
+        kb = pad_batch(ka, ck, mk)
+        vb = pad_batch(va, ck, mk)
+        klens = jnp.asarray(ck[1:] - ck[:-1])
+        kmask = (jnp.arange(mk)[None, :] < klens[:, None])
+        bias = jnp.where(kmask, 0.0, -jnp.inf)[:, None, None, :]
+        out = _sdpa_reference(qb, kb, vb, causal=causal, mask=bias,
+                              dropout=dropout if training else 0.0,
+                              scale=scale)
+        return jnp.concatenate(
+            [out[i, :int(cq[i + 1] - cq[i])] for i in range(nseq)], axis=0)
+
+    out = apply(fn, query, key, value, _name="flash_attn_unpadded")
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, fixed_seed_offset=None,
+                                rng_name="", training=True, varlen_padded=True,
+                                name=None):
+    """Varlen packed-QKV (reference flash_attn_varlen_qkvpacked):
+    qkv [total_tokens, 3, h, d] -> unpack (grad-preserving slices) +
+    unpadded path."""
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    return flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k,
+                               max_seqlen_q, max_seqlen_k, scale=scale,
+                               dropout=dropout, causal=causal,
+                               return_softmax=return_softmax,
+                               training=training)
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,

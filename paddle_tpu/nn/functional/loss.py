@@ -306,3 +306,90 @@ def identity_loss(x, reduction="none", name=None):
     if reduction in (1, "mean"):
         return apply(jnp.mean, x, _name="identity_loss")
     return x
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-family margin softmax (reference
+    `python/paddle/nn/functional/loss.py` margin_cross_entropy /
+    `phi/kernels/margin_cross_entropy_kernel`): logits are cosines; the
+    target class logit becomes cos(m1*theta + m2) - m3, scaled by s.
+    Single-device dense path (the model-parallel variant lives in
+    fleet's ParallelCrossEntropy)."""
+    lbl = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+    lbl = lbl.reshape(-1)
+
+    def fn(cos_t):
+        c = jnp.clip(cos_t.astype(jnp.float32), -1.0, 1.0)
+        theta = jnp.arccos(c)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lbl, c.shape[-1], dtype=c.dtype)
+        out = jnp.where(onehot > 0, target, c) * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.take_along_axis(logp, lbl[:, None], axis=-1)[:, 0]
+        sm = jnp.exp(logp)
+        return _reduce(loss, reduction), sm
+
+    loss, sm = apply(lambda a: fn(a), logits, _name="margin_cross_entropy")
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference
+    `python/paddle/nn/functional/loss.py` hsigmoid_loss /
+    `phi/kernels/hsigmoid_loss_kernel`). Default complete-binary-tree
+    coding over num_classes, or custom (path_table, path_code)."""
+    import numpy as np
+
+    lbl = label._data if isinstance(label, Tensor) else jnp.asarray(label)
+    lbl = lbl.reshape(-1)
+
+    if path_table is None:
+        # complete binary tree: internal nodes 0..num_classes-2; leaf c sits
+        # at heap position num_classes-1+c; path = ancestors root->parent,
+        # code = left(0)/right(1) turns (the reference's default coding)
+        depth = int(np.ceil(np.log2(max(num_classes, 2))))
+        tables, codes = [], []
+        for c in range(num_classes):
+            pos = num_classes - 1 + c
+            pt, pc = [], []
+            while pos > 0:
+                parent = (pos - 1) // 2
+                pt.append(parent)
+                pc.append(float(pos == 2 * parent + 2))
+                pos = parent
+            pt, pc = pt[::-1], pc[::-1]
+            pt += [-1] * (depth - len(pt))
+            pc += [0.0] * (depth - len(pc))
+            tables.append(pt[:depth])
+            codes.append(pc[:depth])
+        table = jnp.asarray(np.asarray(tables, np.int32))[lbl]
+        code = jnp.asarray(np.asarray(codes, np.float32))[lbl]
+    else:
+        pt = path_table._data if isinstance(path_table, Tensor) \
+            else jnp.asarray(path_table)
+        pc = path_code._data if isinstance(path_code, Tensor) \
+            else jnp.asarray(path_code)
+        table, code = pt[lbl], pc[lbl].astype(jnp.float32)
+
+    valid = (table >= 0).astype(jnp.float32)
+    safe_t = jnp.maximum(table, 0)
+
+    def fn(x, w, *b):
+        # w: [num_internal_nodes, feature]; per-sample node rows
+        wrows = w[safe_t]                       # [B, D, feat]
+        logit = jnp.einsum("bdf,bf->bd", wrows, x.astype(jnp.float32))
+        if b:
+            logit = logit + b[0].reshape(-1)[safe_t]
+        # BCE-with-logits against the path code, masked to real path length
+        lo = jnp.maximum(logit, 0) - logit * code + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+        return jnp.sum(lo * valid, axis=-1, keepdims=True)
+
+    args = (input, weight) + ((bias,) if bias is not None else ())
+    return apply(fn, *args, _name="hsigmoid_loss")

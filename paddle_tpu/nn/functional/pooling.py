@@ -56,26 +56,86 @@ def _reduce_pool(x, kernel, stride, padding, n, init, op, data_format, count_inc
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
-    out = _reduce_pool(x, kernel_size, stride, padding, 1, -jnp.inf, jax.lax.max, "NCL")
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        return _max_pool_with_index(x, kernel_size, stride, padding, 1, "NCL")
+    return _reduce_pool(x, kernel_size, stride, padding, 1, -jnp.inf, jax.lax.max, "NCL")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCHW", name=None):
-    out = _reduce_pool(x, kernel_size, stride, padding, 2, -jnp.inf, jax.lax.max, data_format)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        return _max_pool_with_index(x, kernel_size, stride, padding, 2,
+                                    data_format)
+    return _reduce_pool(x, kernel_size, stride, padding, 2, -jnp.inf, jax.lax.max, data_format)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCDHW", name=None):
-    out = _reduce_pool(x, kernel_size, stride, padding, 3, -jnp.inf, jax.lax.max, data_format)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        return _max_pool_with_index(x, kernel_size, stride, padding, 3,
+                                    data_format)
+    return _reduce_pool(x, kernel_size, stride, padding, 3, -jnp.inf, jax.lax.max, data_format)
 
 
-def _pool_mask(x, out):
-    # best-effort indices (paddle returns argmax positions); rarely consumed
-    return Tensor(jnp.zeros(out.shape, jnp.int64))
+def _max_pool_with_index(x, kernel, stride, padding, n, data_format):
+    """Max pooling returning REAL argmax indices (flat offset within each
+    (N, C) spatial slab — the reference max_poolNd_with_index semantics,
+    `phi/kernels/pool_kernel` MaxPoolWithIndex), the exact inverse input
+    max_unpoolNd expects. Values go through the standard (differentiable)
+    reduce_window max; indices via sliding-window patches + argmax under
+    stop_gradient (indices carry no gradient)."""
+    k = _tuple(kernel, n)
+    st = _tuple(stride if stride is not None else kernel, n)
+    cf = data_format.startswith("NC")
+    pad = _pad_cfg(padding, n)
+    if isinstance(pad, str):
+        raise ValueError("return_mask needs explicit int padding")
+    pad_lo = [p[0] for p in pad]
 
+    def fn(a):
+        if not cf:  # normalize to channels-first
+            perm = (0, n + 1) + tuple(range(1, n + 1))
+            a = jnp.transpose(a, perm)
+        N, C = a.shape[:2]
+        sp = a.shape[2:]
+        window = (1, 1) + k
+        strides = (1, 1) + st
+        pad_full = [(0, 0), (0, 0)] + list(pad)
+        out = jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window,
+                                    strides, pad_full)
+
+        a_sg = jax.lax.stop_gradient(a)
+        a_pad = jnp.pad(a_sg, pad_full, constant_values=-jnp.inf)
+        pats = jax.lax.conv_general_dilated_patches(
+            a_pad, filter_shape=k, window_strides=st,
+            padding=[(0, 0)] * n)
+        osp = pats.shape[2:]
+        prodk = int(np.prod(k))
+        # feature dim is (C, *k) with C slowest
+        pats = pats.reshape((N, C, prodk) + osp)
+        off = jnp.argmax(pats, axis=2)  # within-window offset, k-row-major
+
+        # decompose the k-major offset into per-dim deltas, add the window
+        # origin, convert to a flat index over the ORIGINAL spatial dims
+        flat = jnp.zeros_like(off)
+        rem = off
+        for i in range(n):
+            tail = int(np.prod(k[i + 1:]))
+            dk = rem // tail
+            rem = rem % tail
+            grid = jnp.arange(osp[i]) * st[i] - pad_lo[i]
+            shape = [1] * off.ndim
+            shape[2 + i] = osp[i]
+            pos = jnp.clip(dk + grid.reshape(shape), 0, sp[i] - 1)
+            flat = flat * sp[i] + pos
+        idx = flat.astype(jnp.int64)
+        if not cf:
+            perm_back = (0,) + tuple(range(2, n + 2)) + (1,)
+            out = jnp.transpose(out, perm_back)
+            idx = jnp.transpose(idx, perm_back)
+        return out, idx
+
+    return apply(fn, x, _name=f"max_pool{n}d_with_index")
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
     return _reduce_pool(x, kernel_size, stride, padding, 1, 0.0, jax.lax.add, "NCL",
@@ -137,19 +197,41 @@ def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     return _adaptive(x, output_size, 3, data_format, True)
 
 
+def _adaptive_max_with_index(x, output_size, n, data_format):
+    """return_mask path: when every spatial dim divides the output size the
+    adaptive windows are uniform, so it IS a regular max pool — reuse the
+    real-index pooling. Ragged windows would need per-window argmax; raise
+    rather than return fake indices."""
+    cf = data_format.startswith("NC")
+    os_ = _tuple(output_size, n)
+    spatial = x.shape[2:2 + n] if cf else x.shape[1:1 + n]
+    os_ = tuple(o if o is not None else sdim
+                for o, sdim in zip(os_, spatial))
+    if any(inp % o != 0 for inp, o in zip(spatial, os_)):
+        raise NotImplementedError(
+            "adaptive_max_pool(return_mask=True) needs input spatial dims "
+            f"divisible by output_size (got {tuple(spatial)} -> {os_}): "
+            "ragged adaptive windows have no uniform argmax indices")
+    k = tuple(inp // o for inp, o in zip(spatial, os_))
+    return _max_pool_with_index(x, k, k, 0, n, data_format)
+
+
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
-    out = _adaptive(x, output_size, 1, "NCL", False)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        return _adaptive_max_with_index(x, output_size, 1, "NCL")
+    return _adaptive(x, output_size, 1, "NCL", False)
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    out = _adaptive(x, output_size, 2, "NCHW", False)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        return _adaptive_max_with_index(x, output_size, 2, "NCHW")
+    return _adaptive(x, output_size, 2, "NCHW", False)
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
-    out = _adaptive(x, output_size, 3, "NCDHW", False)
-    return (out, _pool_mask(x, out)) if return_mask else out
+    if return_mask:
+        return _adaptive_max_with_index(x, output_size, 3, "NCDHW")
+    return _adaptive(x, output_size, 3, "NCDHW", False)
 
 
 def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -165,3 +247,69 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0, ceil_mode=False
     pooled = _reduce_pool(powed, kernel_size, stride, padding, 2, 0.0, jax.lax.add, data_format,
                           is_avg=False)
     return _apply(lambda a: jnp.power(a, 1.0 / p), pooled, _name="lp_root")
+
+
+def _max_unpool(x, indices, ndim, kernel_size, stride, padding, output_size,
+                data_format, name):
+    """Scatter pooled values back to their argmax positions (reference
+    `python/paddle/nn/functional/pooling.py` max_unpool2d/3d,
+    `phi/kernels/unpool_kernel`). `indices` are flat offsets within each
+    (N, C) spatial slab, as produced by max_poolNd(return_mask=True)."""
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * ndim
+    if stride is None:
+        stride = kernel_size
+    elif isinstance(stride, int):
+        stride = (stride,) * ndim
+    pad = (padding,) * ndim if isinstance(padding, int) else tuple(padding)
+
+    def out_shape(in_sp):
+        if output_size is not None:
+            sp = tuple(int(s) for s in output_size)[-ndim:]
+            return sp
+        return tuple((in_sp[i] - 1) * stride[i] - 2 * pad[i] + kernel_size[i]
+                     for i in range(ndim))
+
+    cf = data_format.startswith("NC")
+
+    def fn(a, idx):
+        if not cf:
+            perm = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
+            a = jnp.transpose(a, perm)
+            idx = jnp.transpose(idx, perm)
+        n, c = a.shape[:2]
+        sp = out_shape(a.shape[2:])
+        flat_len = 1
+        for s in sp:
+            flat_len *= s
+        av = a.reshape(n, c, -1)
+        iv = idx.reshape(n, c, -1).astype(jnp.int32)
+        out = jnp.zeros((n, c, flat_len), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].set(v)))(out, iv, av)
+        out = out.reshape((n, c) + sp)
+        if not cf:
+            out = jnp.transpose(out, (0,) + tuple(range(2, out.ndim)) + (1,))
+        return out
+
+    from paddle_tpu.core.tensor import apply as _apply
+
+    return _apply(fn, x, indices, _name=f"max_unpool{ndim}d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 2, kernel_size, stride, padding,
+                       output_size, data_format, name)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, 3, kernel_size, stride, padding,
+                       output_size, data_format, name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, 1, kernel_size, stride, padding,
+                       output_size, data_format, name)

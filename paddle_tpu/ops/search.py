@@ -108,3 +108,75 @@ def index_sample(x, index):
     from paddle_tpu.ops.manipulation import index_sample as _is
 
     return _is(x, index)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None, **kwargs):
+    """Nucleus sampling (reference `python/paddle/tensor/search.py`
+    top_p_sampling / `phi/kernels/top_p_sampling_kernel`): keep the
+    smallest prefix of descending-probability tokens whose cumulative
+    mass reaches ps, renormalize, sample. x: [batch, vocab] probs;
+    ps: [batch] or [batch, 1]. Returns (sampled_prob, sampled_id)."""
+    import jax
+
+    from paddle_tpu.framework import random as _rng
+
+    pv = ps._data if isinstance(ps, Tensor) else jnp.asarray(ps)
+    pv = pv.reshape(-1, 1).astype(jnp.float32)
+    key = _rng.next_key() if seed in (None, -1) else jax.random.key(seed)
+
+    def fn(probs):
+        p = probs.astype(jnp.float32)
+        order = jnp.argsort(-p, axis=-1)
+        sp = jnp.take_along_axis(p, order, axis=-1)
+        cum = jnp.cumsum(sp, axis=-1)
+        # keep tokens whose PRECEDING mass < ps (always keeps the top-1)
+        keep = (cum - sp) < pv
+        filt = jnp.where(keep, sp, 0.0)
+        filt = filt / jnp.sum(filt, axis=-1, keepdims=True)
+        gumbel = -jnp.log(-jnp.log(
+            jax.random.uniform(key, filt.shape) + 1e-20) + 1e-20)
+        choice = jnp.argmax(jnp.log(jnp.maximum(filt, 1e-20)) + gumbel,
+                            axis=-1)
+        ids = jnp.take_along_axis(order, choice[:, None], axis=-1)
+        scores = jnp.take_along_axis(p, ids, axis=-1)
+        return scores, ids.astype(jnp.int64)
+
+    return apply(fn, x, _name="top_p_sampling")
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per sequence pair (reference
+    `python/paddle/nn/functional/loss.py` edit_distance /
+    `phi/kernels/edit_distance_kernel`). Host-side DP (the reference also
+    runs it as a CPU metric op). Returns (distance [B, 1], seq_num)."""
+    import numpy as np
+
+    a = np.asarray(input.numpy() if isinstance(input, Tensor) else input)
+    b = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+    il = (np.asarray(input_length.numpy()
+                     if isinstance(input_length, Tensor) else input_length)
+          if input_length is not None else np.full(a.shape[0], a.shape[1]))
+    ll = (np.asarray(label_length.numpy()
+                     if isinstance(label_length, Tensor) else label_length)
+          if label_length is not None else np.full(b.shape[0], b.shape[1]))
+    ignored = set(ignored_tokens or ())
+
+    def one(sa, sb):
+        sa = [t for t in sa if t not in ignored]
+        sb = [t for t in sb if t not in ignored]
+        m, n = len(sa), len(sb)
+        prev = list(range(n + 1))
+        for i in range(1, m + 1):
+            cur = [i] + [0] * n
+            for j in range(1, n + 1):
+                cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                             prev[j - 1] + (sa[i - 1] != sb[j - 1]))
+            prev = cur
+        return prev[n], n
+
+    out = np.zeros((a.shape[0], 1), np.float32)
+    for i in range(a.shape[0]):
+        d, n = one(list(a[i][:int(il[i])]), list(b[i][:int(ll[i])]))
+        out[i, 0] = d / max(n, 1) if normalized else d
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(a.shape[0]))

@@ -39,21 +39,8 @@ class ProfilerState(Enum):
     RECORD_AND_RETURN = 3
 
 
-class SortedKeys(Enum):
-    CPUTotal = 0
-    CPUAvg = 1
-    CPUMax = 2
-    GPUTotal = 3
-
-
-class SummaryView(Enum):
-    DeviceView = 0
-    OverView = 1
-    ModelView = 2
-    DistributedView = 3
-    KernelView = 4
-    OperatorView = 5
-    MemoryView = 6
+from paddle_tpu.profiler.profiler_statistic import (  # noqa: E402
+    SortedKeys, StatisticData, SummaryView, build_table)
 
 
 def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
@@ -73,7 +60,8 @@ def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
     return schedule
 
 
-_events = defaultdict(list)  # name -> [durations]
+_events = defaultdict(list)  # user RecordEvent name -> [durations]
+_op_events = defaultdict(list)  # op name -> [host dispatch durations]
 
 
 class RecordEvent:
@@ -137,6 +125,9 @@ class Profiler:
         self._last_step_t = None
 
     def start(self):
+        # fresh op table per session — successive profiler runs must not
+        # mix per-op stats (user RecordEvents keep their own lifetime)
+        _op_events.clear()
         self._last_step_t = time.perf_counter()
         if not self.timer_only:
             import jax
@@ -146,9 +137,18 @@ class Profiler:
                 self._tracing = True
             except Exception:
                 self._tracing = False
+        # per-op host tracing on the dispatch waist (reference host tracer's
+        # RecordEvent bracket in every generated api, api_base.py:1356)
+        from paddle_tpu.core import tensor as _core_tensor
+
+        _core_tensor._op_tracer = \
+            lambda name, dur: _op_events[name].append(dur)
         self.current_state = ProfilerState.RECORD
 
     def stop(self):
+        from paddle_tpu.core import tensor as _core_tensor
+
+        _core_tensor._op_tracer = None
         if self._tracing:
             import jax
 
@@ -172,15 +172,15 @@ class Profiler:
         return f"avg step time {avg * 1e3:.2f} ms over {len(self._step_times)} steps"
 
     def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
-                thread_sep=False, time_unit="ms"):
-        lines = [f"{'event':<40}{'calls':>8}{'total(ms)':>12}{'avg(ms)':>12}"]
-        items = sorted(_events.items(),
-                       key=lambda kv: -sum(kv[1]))
-        for name, durs in items:
-            lines.append(f"{name:<40}{len(durs):>8}"
-                         f"{sum(durs) * 1e3:>12.3f}"
-                         f"{sum(durs) / len(durs) * 1e3:>12.3f}")
-        table = "\n".join(lines)
+                thread_sep=False, time_unit="ms", views=None, row_limit=100):
+        """Aggregated statistic tables (reference profiler_statistic.py
+        `_build_table`): Overview / Model / Operator / UserDefined / Memory
+        views with sort keys — over host op-dispatch events, RecordEvent
+        brackets, and step timings."""
+        data = StatisticData(_op_events, _events, self._step_times)
+        table = build_table(data, sorted_by=sorted_by, views=views,
+                            time_unit=time_unit, row_limit=row_limit,
+                            op_detail=op_detail)
         print(table)
         return table
 

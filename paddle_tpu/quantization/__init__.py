@@ -23,6 +23,7 @@ __all__ = [
     "fake_quantize_moving_average_abs_max",
     "quantize_linear", "dequantize_linear",
     "weight_quantize", "weight_dequantize", "weight_only_linear",
+    "llm_int8_linear",
     "apply_per_channel_scale",
     "QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
 ]
@@ -261,3 +262,34 @@ class PTQ:
                     sub._quant_weight, sub._quant_scale)._data.astype(
                         sub.weight._data.dtype)
         return model
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0, name=None):
+    """LLM.int8() mixed-precision matmul (reference
+    `python/paddle/nn/quant/quantized_linear.py` llm_int8_linear /
+    `phi/kernels/llm_int8_linear_kernel`): activations are quantized to
+    int8 per row for the int8 x int8 product, EXCEPT the feature columns
+    whose max-abs exceeds `threshold` (emergent outliers) — those keep the
+    float path. Without the split the outlier columns would dominate the
+    per-row activation scale and crush everyone else's quant resolution."""
+    def fn(a, w, s):
+        wscale = s.astype(jnp.float32) / 127.0
+        wf = w.astype(jnp.float32) * wscale
+        a32 = a.astype(jnp.float32)
+        amax_col = jnp.max(jnp.abs(a32), axis=tuple(range(a.ndim - 1)),
+                           keepdims=True)
+        outlier = (amax_col > threshold).astype(jnp.float32)
+        a_in = a32 * (1 - outlier)
+        # per-row symmetric int8 activation quant on the non-outlier part
+        ascale = jnp.max(jnp.abs(a_in), axis=-1, keepdims=True) / 127.0
+        ascale = jnp.maximum(ascale, 1e-9)
+        aq = jnp.round(a_in / ascale)  # int8-valued
+        quant = (aq @ w.astype(jnp.float32)) * ascale * wscale
+        dense = (a32 * outlier) @ wf   # outlier columns stay float
+        return (dense + quant).astype(a.dtype)
+
+    out = apply(fn, x, weight, weight_scale, _name="llm_int8_linear")
+    if bias is not None:
+        out = apply(jnp.add, out, bias, _name="bias_add")
+    return out

@@ -146,3 +146,169 @@ def test_rank_death_detected():
             for p in (p0, p1):
                 if p.poll() is None:
                     p.kill()
+
+
+WORKER_TRAIN = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = jax.process_index()
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()
+
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.distributed.hybrid_engine import HybridParallelEngine
+
+    cfg = LlamaConfig.tiny(
+        num_hidden_layers=4, hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, vocab_size=128, max_position_embeddings=64)
+    # dp axis spans the two processes (jax.devices() is process-major):
+    # the dp grad psum and the ZeRO-1 moment reduce-scatter ride the
+    # cross-process transport
+    eng = HybridParallelEngine(cfg, dp=2, pp=2, mp=2, micro_batches=2,
+                               lr=1e-3)
+    d0 = eng.mesh.devices[0].ravel()
+    d1 = eng.mesh.devices[1].ravel()
+    assert {d.process_index for d in d0} != {d.process_index for d in d1} \
+        or jax.process_count() == 1, "dp must span processes"
+    params, opt = eng.init_state(0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (8, 32)).astype(np.int32)
+    labels = rng.integers(0, 128, (8, 32)).astype(np.int32)
+    for step in range(3):
+        loss, params, opt = eng.train_batch(params, opt, ids, labels)
+        print(f"RANK{rank}_STEP{step}_LOSS={float(loss):.6f}", flush=True)
+    print(f"RANK{rank}_TRAIN_OK", flush=True)
+""")
+
+
+def test_two_process_compiled_train_step():
+    """A compiled HybridParallelEngine train step executes across 2
+    jax.distributed CPU processes (4 virtual devices each, dp spanning the
+    process boundary) and its per-step losses match the single-process run
+    of the identical config — the reference's multi-process-as-cluster
+    methodology (test_dist_base.py:957) applied to the compiled engine
+    (VERDICT r3 item 3)."""
+    port = _free_port()
+    master = f"127.0.0.1:{port}"
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        open(script, "w").write(WORKER_TRAIN)
+        procs = [_spawn(script, r, 2, master) for r in range(2)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r} failed:\n{out}"
+            assert f"RANK{r}_TRAIN_OK" in out
+
+        # per-step losses agree across ranks (replicated loss)
+        def losses(out, r):
+            vals = []
+            for s in range(3):
+                tag = f"RANK{r}_STEP{s}_LOSS="
+                line = [l for l in out.splitlines() if l.startswith(tag)]
+                assert line, (tag, out)
+                vals.append(float(line[0][len(tag):]))
+            return vals
+
+        l0, l1 = losses(outs[0], 0), losses(outs[1], 1)
+        assert l0 == l1, (l0, l1)
+
+        # single-process reference: same engine, same data, local 8-device
+        # mesh (the pytest process runs with 8 virtual CPU devices)
+        import jax
+        import numpy as np
+
+        from paddle_tpu.distributed.hybrid_engine import HybridParallelEngine
+        from paddle_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.tiny(
+            num_hidden_layers=4, hidden_size=64, intermediate_size=128,
+            num_attention_heads=4, vocab_size=128,
+            max_position_embeddings=64)
+        eng = HybridParallelEngine(cfg, dp=2, pp=2, mp=2, micro_batches=2,
+                                   lr=1e-3)
+        params, opt = eng.init_state(0)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, (8, 32)).astype(np.int32)
+        labels = rng.integers(0, 128, (8, 32)).astype(np.int32)
+        ref = []
+        for _ in range(3):
+            loss, params, opt = eng.train_batch(params, opt, ids, labels)
+            ref.append(float(loss))
+        np.testing.assert_allclose(l0, ref, rtol=1e-4, atol=1e-5)
+
+
+WORKER_PIPE = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    rank = jax.process_index()
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        PipelineLayer)
+    from paddle_tpu.distributed.pipeline_engine import PipelineEngine
+    from paddle_tpu.models.bert import (BertConfig, BertMLMLoss,
+                                        bert_pipeline_descs)
+
+    cfg = BertConfig(vocab_size=256, hidden_size=32, num_hidden_layers=4,
+                     num_attention_heads=4, intermediate_size=64,
+                     max_position_embeddings=32, hidden_dropout_prob=0.0)
+    pipe = PipelineLayer(layers=bert_pipeline_descs(cfg), num_stages=2,
+                         loss_fn=BertMLMLoss())
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=pipe.parameters())
+    eng = PipelineEngine(pipe, optimizer=opt, dp=2, pp=2, mp=2,
+                         micro_batches=2)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    labels = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int64)
+    for step in range(2):
+        loss = eng.train_batch([ids], [labels])
+        print(f"RANK{rank}_PSTEP{step}_LOSS={float(loss):.6f}", flush=True)
+    print(f"RANK{rank}_PIPE_OK", flush=True)
+""")
+
+
+def test_two_process_pipeline_engine_train():
+    """PipelineEngine train_batch across 2 jax.distributed processes (the
+    GSPMD shift-register pipeline's collective-permute and the dp grad
+    psum riding the cross-process transport)."""
+    port = _free_port()
+    master = f"127.0.0.1:{port}"
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        open(script, "w").write(WORKER_PIPE)
+        procs = [_spawn(script, r, 2, master) for r in range(2)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r} failed:\n{out}"
+            assert f"RANK{r}_PIPE_OK" in out
+        l0 = [l.split("=")[1] for l in outs[0].splitlines()
+              if l.startswith("RANK0_PSTEP")]
+        l1 = [l.split("=")[1] for l in outs[1].splitlines()
+              if l.startswith("RANK1_PSTEP")]
+        assert l0 == l1 and len(l0) == 2, (l0, l1)
