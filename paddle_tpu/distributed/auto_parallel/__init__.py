@@ -1,0 +1,13 @@
+"""paddle.distributed.auto_parallel (reference
+`python/paddle/distributed/auto_parallel/`): the semi-auto dygraph API
+(shard_tensor / reshard / shard_layer, re-exported from distributed.api)
+plus the static Engine + Strategy."""
+
+from paddle_tpu.distributed.api import (  # noqa: F401
+    dtensor_from_fn, reshard, shard_layer, shard_tensor,
+)
+from paddle_tpu.distributed.auto_parallel.strategy import Strategy  # noqa: F401
+from paddle_tpu.distributed.auto_parallel import static  # noqa: F401
+
+__all__ = ["shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
+           "Strategy", "static"]

@@ -1,0 +1,124 @@
+"""auto_parallel Strategy (reference
+`python/paddle/distributed/auto_parallel/strategy.py`): a config tree of
+parallelization/optimization knobs consumed by the static Engine.
+
+Same surface (strategy.sharding.enable / .stage / .degree, recompute, amp,
+pipeline, gradient_merge, mp/dp optimization blocks); on this stack the
+knobs select mesh axes and engine modes instead of graph passes.
+"""
+
+from __future__ import annotations
+
+import copy
+
+__all__ = ["Strategy"]
+
+
+class BaseConfig:
+    def __init__(self, category, config_dict=None):
+        self._category = category
+        for k, v in self._defaults().items():
+            setattr(self, k, v)
+        if config_dict:
+            for k, v in config_dict.items():
+                setattr(self, k, v)
+
+    def _defaults(self):
+        return {}
+
+    def to_dict(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def __repr__(self):
+        kv = ", ".join(f"{k}={v!r}" for k, v in sorted(
+            self.to_dict().items()))
+        return f"{type(self).__name__}({kv})"
+
+
+class RecomputeConfig(BaseConfig):
+    def _defaults(self):
+        return {"enable": False, "checkpoints": None,
+                "no_recompute_segments": [], "sr": 0, "refined_ops_patterns": []}
+
+
+class AMPConfig(BaseConfig):
+    def _defaults(self):
+        return {"enable": False, "dtype": "bfloat16", "level": "O1",
+                "init_loss_scaling": 32768.0, "use_master_grad": False,
+                "custom_white_list": [], "custom_black_list": []}
+
+
+class ShardingConfig(BaseConfig):
+    def _defaults(self):
+        return {"enable": False, "stage": 1, "degree": 8,
+                "overlap_comm_cacl": False, "param_comm_stream_num": 1}
+
+
+class GradientMergeConfig(BaseConfig):
+    def _defaults(self):
+        return {"enable": False, "k_steps": 1, "avg": True}
+
+
+class PipelineConfig(BaseConfig):
+    def _defaults(self):
+        return {"enable": False, "schedule_mode": "1F1B",
+                "micro_batch_size": 1, "accumulate_steps": 1,
+                "pp_degree": 1, "vpp_degree": 1}
+
+
+class MPOptimizationConfig(BaseConfig):
+    def _defaults(self):
+        return {"enable": False, "degree": 1,
+                "allreduce_matmul_grad_overlapping": False}
+
+
+class DPOptimizationConfig(BaseConfig):
+    def _defaults(self):
+        return {"enable": False, "degree": None,
+                "fuse_all_reduce_ops": True, "overlap_comm_cacl": True}
+
+
+class FusedPassesConfig(BaseConfig):
+    def _defaults(self):
+        return {"enable": False, "fused_passes_list": []}
+
+
+class TuningConfig(BaseConfig):
+    def _defaults(self):
+        return {"enable": False, "profile_start_step": 1,
+                "profile_end_step": 1, "run_after_tuning": True,
+                "verbose": True}
+
+
+class Strategy(BaseConfig):
+    """Reference strategy.py:191. `auto_mode` in
+    {"semi" (annotation-driven, default), "full"}; the sub-configs mirror
+    the reference names so user configs port over unchanged."""
+
+    def __init__(self, config=None):
+        if isinstance(config, str):
+            raise NotImplementedError(
+                "YAML strategy files: pass a dict instead on this build")
+        cfg = dict(config or {})
+        self.auto_mode = cfg.pop("auto_mode", "semi")
+        self.seed = cfg.pop("seed", None)
+
+        self.recompute = RecomputeConfig("recompute", cfg.pop("recompute", None))
+        self.amp = AMPConfig("amp", cfg.pop("amp", None))
+        self.sharding = ShardingConfig("sharding", cfg.pop("sharding", None))
+        self.gradient_merge = GradientMergeConfig(
+            "gradient_merge", cfg.pop("gradient_merge", None))
+        self.pipeline = PipelineConfig("pipeline", cfg.pop("pipeline", None))
+        self.mp_optimization = MPOptimizationConfig(
+            "mp_optimization", cfg.pop("mp_optimization", None))
+        self.dp_optimization = DPOptimizationConfig(
+            "dp_optimization", cfg.pop("dp_optimization", None))
+        self.fused_passes = FusedPassesConfig(
+            "fused_passes", cfg.pop("fused_passes", None))
+        self.tuning = TuningConfig("tuning", cfg.pop("tuning", None))
+        for k, v in cfg.items():
+            setattr(self, k, v)
+
+    def copy(self):
+        return copy.deepcopy(self)

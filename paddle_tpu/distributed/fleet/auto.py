@@ -1,0 +1,13 @@
+"""`paddle.distributed.fleet.auto` (reference
+`python/paddle/distributed/fleet/__init__.py` re-export of auto_parallel):
+the canonical spelling `from paddle.distributed.fleet import auto;
+auto.Engine(...)`."""
+
+from paddle_tpu.distributed.api import (  # noqa: F401
+    dtensor_from_fn, reshard, shard_layer, shard_tensor,
+)
+from paddle_tpu.distributed.auto_parallel.static import Engine  # noqa: F401
+from paddle_tpu.distributed.auto_parallel.strategy import Strategy  # noqa: F401
+
+__all__ = ["Engine", "Strategy", "shard_tensor", "reshard", "shard_layer",
+           "dtensor_from_fn"]

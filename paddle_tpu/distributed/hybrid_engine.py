@@ -99,7 +99,8 @@ class HybridParallelEngine:
 
     def __init__(self, config, dp=1, pp=1, mp=1, micro_batches=None, sp=False,
                  devices=None, dtype=jnp.float32, remat=True, lr=3e-4,
-                 schedule="gpipe", num_virtual_stages=2, zero_stage=1):
+                 schedule="gpipe", num_virtual_stages=2, zero_stage=1,
+                 loss_chunk=None):
         from paddle_tpu.models.llama import LlamaConfig  # noqa: F401 (type)
 
         self.config = config
@@ -110,6 +111,10 @@ class HybridParallelEngine:
         self.dtype = dtype
         self.remat = remat
         self.lr = lr
+        # sequence-chunked CE (single-device path only): the [b, s, vocab]
+        # f32 logits never materialize at once — vocab matmul + CE run per
+        # seq chunk with rematerialization (forward_and_loss loss_chunk)
+        self.loss_chunk = loss_chunk
         # ZeRO: stage 1/2 = dp-sharded AdamW moments (in ONE compiled step
         # the stage-1/2 distinction collapses — XLA frees grads inside the
         # program); stage 3 additionally shards the LAYER params over 'dp':
@@ -846,7 +851,8 @@ class HybridParallelEngine:
         args, M = self.args, self.micro_batches
 
         def mb_loss(p, i, l):
-            return lf.forward_and_loss(p, i, l, args, remat=self.remat)
+            return lf.forward_and_loss(p, i, l, args, remat=self.remat,
+                                       loss_chunk=self.loss_chunk)
 
         if M == 1:
             return jax.value_and_grad(mb_loss)(params, ids[0], labels[0])

@@ -210,10 +210,15 @@ def multi_dot(x, name=None):
     return apply_multi(lambda arrs: jnp.linalg.multi_dot(arrs), list(x), _name="multi_dot")
 
 
-def histogram(input, bins=100, min=0, max=0, name=None):
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
     arr = np.asarray(input._data)
     rng = None if (min == 0 and max == 0) else (min, max)
-    hist, _ = np.histogram(arr, bins=bins, range=rng)
+    w = np.asarray(weight._data) if weight is not None else None
+    hist, _ = np.histogram(arr, bins=bins, range=rng, weights=w,
+                           density=density)
+    if density or w is not None:
+        return Tensor(jnp.asarray(hist.astype(np.float32)))
     return Tensor(jnp.asarray(hist.astype(np.int64)))
 
 

@@ -120,9 +120,16 @@ def dequantize_linear(x, scale, zero_point=0, quant_axis=-1, name=None):
     return Tensor((x._data.astype(jnp.float32) - zero_point) * s)
 
 
-def weight_quantize(x, algo="weight_only_int8", name=None):
+def weight_quantize(x, algo="weight_only_int8", arch=None,
+                    group_size=-1, name=None):
     """-> (int8 weight, per-out-channel scale). Reference
-    weight_quantize_kernel; weights are [in, out]."""
+    weight_quantize_kernel; weights are [in, out]. group_size=-1
+    (per-channel) is the supported granularity; `arch` is a GPU SM
+    selector with no TPU meaning (accepted, ignored)."""
+    if group_size not in (-1, None):
+        raise NotImplementedError(
+            "group-wise weight quantization (group_size > 0) is not "
+            "implemented; use per-channel (group_size=-1)")
     a = x._data
     scale = jnp.max(jnp.abs(a), axis=0)
     q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-9) * 127), -127,
@@ -130,13 +137,27 @@ def weight_quantize(x, algo="weight_only_int8", name=None):
     return Tensor(q), Tensor(scale.astype(jnp.float32))
 
 
-def weight_dequantize(x, scale, algo="weight_only_int8", name=None):
-    return Tensor(x._data.astype(jnp.float32) * scale._data / 127.0)
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float32", arch=None,
+                      group_size=-1, name=None):
+    if group_size not in (-1, None):
+        raise NotImplementedError(
+            "group-wise weight dequantization is not implemented")
+    from paddle_tpu.framework import dtypes as _dt
+
+    out = x._data.astype(jnp.float32) * scale._data / 127.0
+    return Tensor(out.astype(_dt.convert_dtype(out_dtype)))
 
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
-                       weight_dtype="int8", name=None):
+                       weight_dtype="int8", arch=None, group_size=-1,
+                       name=None):
     """x @ dequant(weight) + bias — the scale*cast fuses into the matmul."""
+    if group_size not in (-1, None):
+        raise NotImplementedError(
+            "group-wise weight_only_linear is not implemented; use "
+            "per-channel scales (group_size=-1)")
+
     def fn(a, w, s):
         wf = w.astype(a.dtype) * (s.astype(a.dtype) / 127.0)
         return a @ wf

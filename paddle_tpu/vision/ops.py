@@ -258,7 +258,8 @@ def box_coder(prior_box, prior_box_var, target_box,
 
 def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
               variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
-              steps=(0.0, 0.0), offset=0.5, name=None):
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
     """SSD prior boxes (reference prior_box_kernel): -> (boxes [H,W,P,4],
     variances [H,W,P,4]) normalized to [0,1]."""
     fh, fw = _data(input).shape[2:]
@@ -270,11 +271,23 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
         ars += [1.0 / a for a in aspect_ratios if a != 1.0]
     sizes = []
     for ms in min_sizes:
-        for a in ars:
-            sizes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
-        if max_sizes:
-            mx = max_sizes[min_sizes.index(ms)]
-            sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+        mx = max_sizes[min_sizes.index(ms)] if max_sizes else None
+        if min_max_aspect_ratios_order:
+            # Caffe layout: [min box, max box, other-ar boxes] — must match
+            # the conv head's channel order (reference prior_box_kernel's
+            # min_max_aspect_ratios_order branch)
+            sizes.append((float(ms), float(ms)))
+            if mx is not None:
+                sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+            for a in ars:
+                if abs(a - 1.0) < 1e-6:
+                    continue
+                sizes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+        else:
+            for a in ars:
+                sizes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+            if mx is not None:
+                sizes.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
     sizes = np.asarray(sizes, np.float32)  # [P, 2] (w, h)
     cy = (np.arange(fh) + offset) * step_h
     cx = (np.arange(fw) + offset) * step_w

@@ -1,0 +1,150 @@
+"""auto_parallel static Engine (VERDICT r3 Missing item 5; reference
+`distributed/auto_parallel/static/engine.py` + `completion.py` +
+`partitioner.py`): annotation-driven completion onto GSPMD, strategy
+routing to the dp/mp and pipeline executors, fit/evaluate/predict/save.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.io import Dataset
+
+
+class _RandomDS(Dataset):
+    def __init__(self, n=64, din=16, classes=4, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(n, din)).astype("float32")
+        self.y = (np.arange(n) % classes).astype("int64")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def test_engine_fit_evaluate_predict_save(tmp_path):
+    from paddle_tpu.distributed.auto_parallel import Strategy
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+
+    model = _mlp()
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+    strategy = Strategy()
+    strategy.sharding.enable = True
+    strategy.sharding.stage = 2
+    eng = Engine(model, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                 strategy=strategy)
+    ds = _RandomDS()
+    hist = eng.fit(ds, epochs=3, batch_size=16)
+    assert len(hist["loss"]) == 3
+    assert hist["loss"][-1] < hist["loss"][0], hist
+
+    ev = eng.evaluate(ds, batch_size=16)
+    assert ev["loss"] is not None and np.isfinite(ev["loss"])
+
+    outs = eng.predict(ds, batch_size=16, steps=1)
+    assert len(outs) == 1
+
+    eng.save(str(tmp_path / "ap_ckpt"))
+    before = {k: np.asarray(v) for k, v in eng._engine.state[0].items()}
+    # perturb then reload
+    eng._engine.state[0] = {k: v * 0 for k, v in eng._engine.state[0].items()}
+    eng.load(str(tmp_path / "ap_ckpt"))
+    after = eng._engine.state[0]
+    for k in before:
+        np.testing.assert_allclose(np.asarray(after[k]), before[k],
+                                   err_msg=k)
+
+
+def test_annotation_completion_mp():
+    """shard_tensor annotations on parameters become the compiled program's
+    sharding (the Completer's dist-attr propagation, done by GSPMD): an
+    mp=2 engine honors a column-sharded Linear weight and still matches
+    the eager loss."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import ProcessMesh
+    from paddle_tpu.distributed.auto_parallel import (
+        Strategy, shard_tensor)
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+    from paddle_tpu.distributed.placement import Replicate, Shard
+
+    model = _mlp()
+    mesh = ProcessMesh(np.arange(2).reshape(2), dim_names=["mp"])
+    # column-parallel first Linear: weight [16, 32] sharded on the out dim
+    w = model[0].weight
+    w_sharded = shard_tensor(w, mesh, [Shard(1)])
+    w._data = w_sharded._data
+
+    eng = Engine(model, loss=nn.CrossEntropyLoss(),
+                 strategy=Strategy({"mp_optimization": {"enable": True,
+                                                        "degree": 2}}))
+    eng.prepare()
+    spec_fn = eng._annotated_spec_fn()
+    assert spec_fn is not None
+    found = {n: spec_fn(n, None) for n, _ in model.named_parameters()}
+    key = [n for n, s in found.items() if s is not None]
+    assert len(key) == 1 and key[0].endswith("weight"), found
+    assert found[key[0]] == P(None, "mp")
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 16)).astype("float32")
+    y = (np.arange(8) % 4).astype("int64")
+    loss = eng._engine.eval_batch([x], [y])
+    ref = nn.CrossEntropyLoss()(model(paddle.to_tensor(x)),
+                                paddle.to_tensor(y))
+    np.testing.assert_allclose(float(loss), float(ref), rtol=2e-5)
+
+
+def test_pipeline_strategy_routes_to_pipeline_engine():
+    from paddle_tpu.distributed.auto_parallel import Strategy
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        LayerDesc, PipelineLayer)
+    from paddle_tpu.distributed.pipeline_engine import PipelineEngine
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 8)
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x))
+
+    pipe = PipelineLayer(layers=[LayerDesc(Block) for _ in range(4)],
+                         num_stages=2,
+                         loss_fn=lambda o, l: paddle.mean((o - l) ** 2))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=pipe.parameters())
+    st = Strategy({"pipeline": {"enable": True, "accumulate_steps": 2}})
+    eng = Engine(pipe, optimizer=opt, strategy=st)
+    eng.prepare()
+    assert isinstance(eng._engine, PipelineEngine)
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(8, 8)).astype("float32")
+    t = np.zeros((8, 8), "float32")
+    losses = [float(eng._engine.train_batch([x], [t])) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_strategy_defaults_match_reference():
+    from paddle_tpu.distributed.auto_parallel import Strategy
+
+    st = Strategy()
+    assert st.sharding.enable is False
+    assert st.sharding.stage == 1
+    assert st.sharding.degree == 8
+    assert st.recompute.enable is False
+    assert st.pipeline.schedule_mode == "1F1B"
+    st2 = Strategy({"sharding": {"enable": True, "stage": 2, "degree": 2}})
+    assert st2.sharding.stage == 2 and st2.sharding.degree == 2

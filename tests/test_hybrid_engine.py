@@ -593,3 +593,19 @@ def test_shard_batch_rejects_bad_preplaced():
     odd = jnp.zeros((2, 3, 8), jnp.int32)
     with pytest.raises(ValueError, match="divisible by dp"):
         eng.shard_batch(odd, odd)
+
+
+def test_trivial_fast_path_loss_chunk_parity():
+    """loss_chunk (seq-chunked CE) through the engine fast path matches the
+    unchunked loss (same math, lower peak memory — the bench's primary
+    config uses it with remat='dots')."""
+    cfg = _tiny_cfg()
+    ids, labels = _batch()
+    e1 = HybridParallelEngine(cfg, dp=1, pp=1, mp=1, micro_batches=1)
+    p1, o1 = e1.init_state(0)
+    l1, _, _ = e1.train_batch(p1, o1, ids, labels)
+    e2 = HybridParallelEngine(cfg, dp=1, pp=1, mp=1, micro_batches=1,
+                              loss_chunk=8)
+    p2, o2 = e2.init_state(0)
+    l2, _, _ = e2.train_batch(p2, o2, ids, labels)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)

@@ -573,3 +573,33 @@ class TestR4AuditOps(OpTest):
         assert abs(t.numpy().mean() - 1.0) < 0.3
         t.exponential_(2.0)
         assert abs(t.numpy().mean() - 0.5) < 0.1
+
+
+def test_op_schema_spine():
+    """The schema registry (tools/op_schema.py — the TPU build's analogue
+    of the reference's single-YAML codegen spine, SURVEY §2.3 L4): parses
+    every ops.yaml entry and enforces signature conformance of every
+    implemented op against the yaml argument list."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "op_schema", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "op_schema.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+
+    schemas = m.load_schemas()
+    assert len(schemas) == 474
+    abs_s = schemas["abs"]
+    assert [a[1] for a in abs_s.args] == ["x"]
+    assert abs_s.backward == "abs_grad"
+    assert abs_s.inplace == "x -> out"
+    cs = schemas["cumsum"]
+    assert [a[1] for a in cs.args] == ["x", "axis", "flatten", "exclusive",
+                                       "reverse"]
+    assert cs.args[1][2] == "-1"  # parsed default
+
+    checked, violations = m.check_conformance(schemas)
+    assert checked >= 280, checked
+    assert not violations, violations

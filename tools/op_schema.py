@@ -1,0 +1,244 @@
+"""Op schema registry: the TPU build's equivalent of the reference's
+YAML codegen spine (SURVEY §2.3 / layer L4).
+
+The reference drives kernels, ad_funcs, and the Python API out of ONE
+schema (`paddle/phi/ops/yaml/ops.yaml` -> `paddle/phi/api/generator/*`,
+`eager_gen.py`). On this stack the ops are hand-written jnp compositions,
+so codegen would only generate wrappers — but the schema's load-bearing
+role (a single machine-readable source of truth the rest of the build is
+CHECKED against) still matters. This module:
+
+  1. parses every ops.yaml entry into OpSchema(name, args, outputs,
+     backward, inplace) — the same grammar the reference generators parse
+     (`parse_utils.py` parse_args);
+  2. resolves each implemented op to our callable (via op_manifest) and
+     verifies SIGNATURE CONFORMANCE: every yaml tensor/attr argument name
+     must be accepted by the Python callable (by name or positionally), so
+     reference user code calling with keyword args keeps working;
+  3. emits the conformance report consumed by tests/test_ops_coverage.py.
+
+Run:  python tools/op_schema.py           # print violations
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+REF_YAML = "/root/reference/paddle/phi/ops/yaml/ops.yaml"
+
+# yaml arg-name -> accepted Python spellings (the reference's own Python API
+# renames these in python/paddle/tensor/*; we conform to the PYTHON api)
+_NAME_EQUIV = {
+    "x": ("x", "input", "a"),
+    "y": ("y", "label", "other", "b"),
+    "axis": ("axis", "dim"),
+    "dtype": ("dtype",),
+    "keepdim": ("keepdim", "keepdims"),
+}
+
+# kernel-schema args the reference's own PYTHON api does not expose (its
+# generated python wrappers fill them internally) — conformance targets the
+# python surface, so these never count as missing. op -> arg names.
+_KERNEL_ONLY = {
+    "cumsum": {"flatten", "exclusive", "reverse"},
+    "logcumsumexp": {"flatten", "exclusive", "reverse"},
+    "dropout": {"seed_tensor", "is_test", "seed", "fix_seed"},
+    "slice": {"infer_flags", "decrease_axis"},
+    "fake_channel_wise_quantize_abs_max": {"round_type", "is_test"},
+    "fake_quantize_moving_average_abs_max": {
+        "in_scale", "in_accum", "in_state", "moving_rate", "is_test",
+        "round_type"},
+    "lp_pool2d": {"strides", "paddings", "exclusive", "pooling_type",
+                  "global_pooling", "adaptive", "padding_algorithm"},
+    "rms_norm": {"bias", "residual", "norm_weight", "norm_bias",
+                 "begin_norm_axis", "quant_scale", "quant_round_type",
+                 "quant_max_bound", "quant_min_bound"},
+    "prior_box": {"variances", "step_w", "step_h"},
+}
+
+
+class OpSchema:
+    __slots__ = ("name", "args", "outputs", "backward", "inplace")
+
+    def __init__(self, name, args, outputs, backward, inplace):
+        self.name = name
+        self.args = args          # [(type, name, default|None)]
+        self.outputs = outputs    # [(type, name)]
+        self.backward = backward
+        self.inplace = inplace
+
+    @property
+    def tensor_args(self):
+        return [a for a in self.args if a[0].startswith("Tensor")]
+
+    @property
+    def attr_args(self):
+        return [a for a in self.args if not a[0].startswith("Tensor")]
+
+    def __repr__(self):
+        return (f"OpSchema({self.name}, args={[a[1] for a in self.args]}, "
+                f"out={[o[1] for o in self.outputs]})")
+
+
+# parts are already comma-split with bracket/brace depth respected, so the
+# default capture may contain commas (e.g. `int[] strides={1, 1}`)
+_ARG_RE = re.compile(
+    r"\s*([\w<>\[\]]+(?:\s*\[\])?)\s+(\w+)\s*(?:=\s*(.+))?$")
+
+
+def _parse_args(argstr):
+    """`(Tensor x, Tensor y, float eps = 1e-5)` -> [(type, name, default)].
+    Mirrors the reference generator's parse_utils.parse_args grammar."""
+    inner = argstr.strip()
+    if inner.startswith("("):
+        inner = inner[1:-1]
+    out = []
+    depth = 0
+    cur = ""
+    parts = []
+    inner = " ".join(inner.split())  # collapse wrapped-line whitespace
+    for ch in inner:
+        if ch in "<[({":
+            depth += 1
+        elif ch in ">])}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        parts.append(cur)
+    for part in parts:
+        m = _ARG_RE.match(part)
+        if m:
+            typ, name, default = m.groups()
+            out.append((typ, name, default.strip() if default else None))
+    return out
+
+
+def _parse_outputs(outstr):
+    outs = []
+    for m in re.finditer(r"([\w<>\[\]]+)\s*\((\w+)\)", outstr):
+        outs.append((m.group(1), m.group(2)))
+    return outs or [("Tensor", "out")]
+
+
+def load_schemas(path=REF_YAML):
+    txt = open(path).read()
+    entries = re.split(r"^- op\s*:\s*", txt, flags=re.M)[1:]
+    schemas = {}
+    for e in entries:
+        name = e.split("\n", 1)[0].strip()
+        # args may wrap over multiple yaml lines: capture from "(" to the
+        # matching close across newlines
+        argm = re.search(r"^\s*args\s*:\s*(\([^)]*\))", e, re.M | re.S)
+        outm = re.search(r"^\s*output\s*:\s*(.+)$", e, re.M)
+        bwm = re.search(r"^\s*backward\s*:\s*(\w+)", e, re.M)
+        inpm = re.search(r"^\s*inplace\s*:\s*\((.+?)\)", e, re.M)
+        schemas[name] = OpSchema(
+            name,
+            _parse_args(argm.group(1)) if argm else [],
+            _parse_outputs(outm.group(1)) if outm else [],
+            bwm.group(1) if bwm else None,
+            inpm.group(1) if inpm else None,
+        )
+    return schemas
+
+
+def _find_callable(where):
+    """'paddle.nn.functional.abs' -> the callable, via paddle_tpu."""
+    import importlib
+
+    t = where.split()[0].split("(")[0]
+    if not t.startswith("paddle."):
+        return None
+    parts = t.split(".")
+    obj, rest = None, parts[1:]
+    for i in range(len(parts), 0, -1):
+        modname = "paddle_tpu" + ("." + ".".join(parts[1:i]) if i > 1 else "")
+        try:
+            obj = importlib.import_module(modname)
+            rest = parts[i:]
+            break
+        except ImportError:
+            continue
+    for part in rest:
+        obj = getattr(obj, part, None)
+    return obj if callable(obj) else None
+
+
+def check_conformance(schemas=None, verbose=False):
+    """For every op op_manifest reports `implemented`, verify our callable
+    can accept the yaml argument list: each yaml arg name (or its Python-
+    api spelling) is a named parameter, or the callable takes *args/**kw,
+    or there are at least as many positional slots as yaml args."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import op_manifest
+
+    schemas = schemas or load_schemas()
+    violations = []
+    checked = 0
+    for name, schema in sorted(schemas.items()):
+        status, where = op_manifest.resolve(name, paddle, F)
+        if status != "implemented":
+            continue
+        fn = _find_callable(where)
+        if fn is None:
+            violations.append((name, where, "target not callable"))
+            continue
+        try:
+            sig = inspect.signature(fn)
+        except (TypeError, ValueError):
+            continue  # builtins/classes without signatures
+        params = sig.parameters
+        has_var = any(p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD)
+                      for p in params.values())
+        n_positional = sum(
+            1 for p in params.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD))
+        checked += 1
+        if has_var or n_positional >= len(schema.args):
+            continue
+        kernel_only = _KERNEL_ONLY.get(name, set())
+        missing = []
+        for _, aname, _ in schema.args:
+            if aname in kernel_only:
+                continue
+            cands = _NAME_EQUIV.get(aname, (aname,))
+            if not any(c in params for c in cands):
+                missing.append(aname)
+        if missing and len(missing) > max(0, len(schema.args) - n_positional):
+            violations.append((name, where,
+                               f"cannot bind yaml args {missing}"))
+    return checked, violations
+
+
+def main():
+    schemas = load_schemas()
+    print(f"parsed {len(schemas)} op schemas from ops.yaml")
+    with_bw = sum(1 for s in schemas.values() if s.backward)
+    print(f"  {with_bw} declare a backward; "
+          f"{sum(1 for s in schemas.values() if s.inplace)} an inplace form")
+    checked, violations = check_conformance(schemas)
+    print(f"signature conformance: {checked} implemented ops checked, "
+          f"{len(violations)} violations")
+    for name, where, why in violations:
+        print(f"  {name} -> {where}: {why}")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Per-chip throughput sweep for the engine fast path.
+
+Sweeps (batch, remat, loss_chunk, micro_batches) on the h2048 primary
+config through bench.py's own `--single` subprocess entry point — same
+timing methodology as the headline benchmark (one implementation), with
+OOM isolation per candidate.
+
+Run:  python tools/perf_sweep.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+H2048 = dict(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+             num_hidden_layers=16, num_attention_heads=16,
+             max_position_embeddings=2048)
+
+# measured on TPU v5e-16G (2026-07): full remat b8 ~17.0k tok/s;
+# remat='half' OOMs at every batch (the f32 AdamW moments leave no room);
+# 'dots' + chunked CE + 2 accumulated micro-batches wins at ~17.5k.
+SPECS = [
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": True},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": True,
+     "loss_chunk": 128},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "half",
+     "loss_chunk": 128},
+    {"cfg": H2048, "batch": 4, "seq": 1024, "remat": "dots",
+     "loss_chunk": 128},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "dots",
+     "loss_chunk": 128, "micro_batches": 2},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "dots",
+     "loss_chunk": 256, "micro_batches": 2},
+    {"cfg": H2048, "batch": 16, "seq": 1024, "remat": True,
+     "loss_chunk": 128},
+]
+
+
+def main():
+    results = []
+    for spec in SPECS:
+        label = {k: v for k, v in spec.items() if k != "cfg"}
+        try:
+            out = subprocess.run(
+                [sys.executable, BENCH, "--single", json.dumps(spec)],
+                capture_output=True, text=True, timeout=900, cwd=REPO)
+            got = None
+            for line in out.stdout.splitlines():
+                if line.startswith("BENCH_RESULT "):
+                    got = json.loads(line[len("BENCH_RESULT "):])
+            if got:
+                got["spec"] = spec
+                results.append(got)
+                print(f"{label} -> {got['tps']:.1f} tok/s", flush=True)
+            else:
+                tail = out.stderr[-500:].replace("\n", " ")
+                print(f"{label} -> FAILED: {tail}", flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"{label} -> TIMEOUT", flush=True)
+    if results:
+        best = max(results, key=lambda r: r["tps"])
+        print("BEST " + json.dumps(
+            {"tps": best["tps"],
+             "spec": {k: v for k, v in best["spec"].items() if k != "cfg"}}))
+
+
+if __name__ == "__main__":
+    main()
