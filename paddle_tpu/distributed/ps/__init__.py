@@ -4,65 +4,239 @@
 huge embedding tables live on server ranks and trainers pull/push rows).
 
 TPU-native scope: the reference's brpc service + table zoo exists for
-CPU-cluster recommendation models; on this stack the *protocol* is what
-matters for capability parity. Tables are numpy-backed on the server
-(sparse rows materialize on demand), transport is the framework's
-`distributed.rpc` (TCPStore-rendezvoused TCP), and trainers embed pulled
-rows into device computations. Dense training should use the collective
-path (fleet/Engine) — this module is for the sparse pull/push pattern.
+CPU-cluster recommendation models; on this stack the *protocol and table
+semantics* are what carry the capability. This module implements, over
+the framework's `distributed.rpc` (TCPStore-rendezvoused TCP):
+
+  - `SparseTable` with pluggable per-row sparse OPTIMIZERS — sgd /
+    adagrad (per-row G2Sum) / adam (per-row moments + step), the
+    reference's sparse_sgd/adagrad/adam rules
+    (`ps/table/sparse_sgd_rule.cc`);
+  - the CTR accessor lifecycle (`ps/table/ctr_accessor.cc`): show/click
+    counters per row, unseen-day aging, and `shrink()` eviction of rows
+    whose decayed score drops below a threshold;
+  - table `save()`/`load()` persistence (the reference's table
+    save/load RPCs);
+  - multi-server deployments: tables key-sharded over several rpc
+    workers by hash (`ps/service/ps_client` row routing), pulls fan out
+    and reassemble in order;
+  - GeoSGD-style async mode: trainers keep a local cache and push
+    accumulated deltas every k steps (`ps/service/communicator.cc` Geo).
+
+Dense training should use the collective path (fleet/Engine) — this
+module is for the sparse pull/push pattern.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 
 import numpy as np
 
-__all__ = ["SparseTable", "init_server", "shutdown_server", "pull_sparse",
-           "push_sparse", "pull_dense", "push_dense", "get_table"]
+__all__ = ["SparseTable", "DenseTable", "init_server", "shutdown_server",
+           "pull_sparse", "push_sparse", "pull_dense", "push_dense",
+           "get_table", "shrink", "save_tables", "load_tables",
+           "GeoSparseCache"]
+
+
+# -- sparse optimizer rules (reference ps/table/sparse_sgd_rule.cc) ---------
+
+
+class _SGDRule:
+    slots = 0
+
+    def update(self, row, slot, g, lr):
+        return row - lr * g, slot
+
+
+class _AdagradRule:
+    """Per-row accumulated squared grad (SparseAdaGradSGDRule)."""
+
+    slots = 1
+
+    def __init__(self, eps=1e-8):
+        self.eps = eps
+
+    def update(self, row, slot, g, lr):
+        g2 = slot[0] + float(np.sum(g * g)) / max(g.size, 1)
+        return row - lr * g / np.sqrt(g2 + self.eps), [g2]
+
+
+class _AdamRule:
+    """Per-row Adam moments (SparseAdamSGDRule)."""
+
+    slots = 3  # m, v, step
+
+    def __init__(self, beta1=0.9, beta2=0.999, eps=1e-8):
+        self.beta1, self.beta2, self.eps = beta1, beta2, eps
+
+    def update(self, row, slot, g, lr):
+        m, v, step = slot
+        step = step + 1
+        m = self.beta1 * m + (1 - self.beta1) * g
+        v = self.beta2 * v + (1 - self.beta2) * g * g
+        mhat = m / (1 - self.beta1 ** step)
+        vhat = v / (1 - self.beta2 ** step)
+        return row - lr * mhat / (np.sqrt(vhat) + self.eps), [m, v, step]
+
+
+_RULES = {"sgd": _SGDRule, "adagrad": _AdagradRule, "adam": _AdamRule}
 
 
 class SparseTable:
-    """Row-sharded embedding table with lazy row creation and SGD push
-    (reference `ps/table/memory_sparse_table.cc` semantics)."""
+    """Row-sharded embedding table with lazy row creation, pluggable sparse
+    optimizer, and the CTR accessor lifecycle (reference
+    `ps/table/memory_sparse_table.cc` + `ctr_accessor.cc`)."""
 
     def __init__(self, dim, initializer="uniform", init_scale=0.01, lr=0.05,
-                 seed=0):
+                 seed=0, optimizer="sgd", show_decay=0.98, **opt_kwargs):
         self.dim = dim
         self.lr = lr
         self.init_scale = init_scale
         self.initializer = initializer
+        self.rule = _RULES[optimizer](**opt_kwargs)
+        self.optimizer = optimizer
+        self.show_decay = show_decay
         self._rows = {}
+        self._slots = {}
+        self._meta = {}  # key -> [show, click]
         self._rng = np.random.RandomState(seed)
         self._lock = threading.Lock()
 
+    def _init_slot(self, g_like):
+        if self.rule.slots == 0:
+            return None
+        if isinstance(self.rule, _AdagradRule):
+            return [0.0]
+        return [np.zeros_like(g_like), np.zeros_like(g_like), 0]
+
     def _row(self, key):
-        r = self._rows.get(int(key))
+        key = int(key)
+        r = self._rows.get(key)
         if r is None:
             if self.initializer == "zeros":
                 r = np.zeros(self.dim, np.float32)
             else:
                 r = self._rng.uniform(-self.init_scale, self.init_scale,
                                       self.dim).astype(np.float32)
-            self._rows[int(key)] = r
+            self._rows[key] = r
+            self._meta[key] = [0.0, 0.0]
         return r
 
-    def pull(self, ids):
+    def pull(self, ids, clicks=None, record_show=True):
+        """Fetch rows; records a SHOW per pulled id (accessor semantics) and
+        optional clicks. record_show=False for transport-internal pulls
+        (Geo cache refresh) so CTR statistics count impressions, not
+        traffic."""
         keys = np.asarray(ids).ravel()
         if keys.size == 0:  # empty feature batch: valid in sparse workloads
             return np.zeros((0, self.dim), np.float32)
         with self._lock:
-            return np.stack([self._row(k) for k in keys])
+            out = np.stack([self._row(k) for k in keys])
+            if record_show:
+                for i, k in enumerate(keys):
+                    m = self._meta[int(k)]
+                    m[0] += 1.0
+                    if clicks is not None:
+                        m[1] += float(np.asarray(clicks).ravel()[i])
+            return out
 
     def push(self, ids, grads, lr=None):
         lr = lr if lr is not None else self.lr
         grads = np.asarray(grads, np.float32)
         with self._lock:
             for k, g in zip(np.asarray(ids).ravel(), grads):
-                self._rows[int(k)] = self._row(k) - lr * g
+                k = int(k)
+                row = self._row(k)
+                slot = self._slots.get(k)
+                if slot is None and self.rule.slots:
+                    slot = self._init_slot(g)
+                new_row, new_slot = self.rule.update(row, slot, g, lr)
+                self._rows[k] = new_row.astype(np.float32)
+                if self.rule.slots:
+                    self._slots[k] = new_slot
+
+    def shrink(self, threshold=1.0):
+        """Decay every row's show counter and EVICT rows whose decayed show
+        drops below threshold (reference MemorySparseTable::Shrink +
+        CtrCommonAccessor::Shrink). Returns evicted count."""
+        with self._lock:
+            dead = []
+            for k, m in self._meta.items():
+                m[0] *= self.show_decay
+                if m[0] < threshold:
+                    dead.append(k)
+            for k in dead:
+                self._rows.pop(k, None)
+                self._slots.pop(k, None)
+                self._meta.pop(k, None)
+            return len(dead)
+
+    def meta(self, key):
+        return tuple(self._meta.get(int(key), (0.0, 0.0)))
 
     def size(self):
         return len(self._rows)
+
+    # -- persistence (reference table save/load RPCs) ----------------------
+    def state(self):
+        with self._lock:  # consistent snapshot vs concurrent push/shrink
+            keys = np.asarray(sorted(self._rows), np.int64)
+            rows = (np.stack([self._rows[int(k)].copy() for k in keys])
+                    if keys.size else np.zeros((0, self.dim), np.float32))
+            meta = (np.asarray([self._meta[int(k)] for k in keys],
+                               np.float32)
+                    if keys.size else np.zeros((0, 2), np.float32))
+            st = {"keys": keys, "rows": rows, "meta": meta,
+                  "optimizer": self.optimizer}
+            # optimizer slot state rides along (adagrad G2Sum / adam
+            # moments+step); dropping it would make the first post-restore
+            # adam push take a full-lr bias-corrected jump
+            if self.optimizer == "adagrad":
+                st["slot_g2"] = np.asarray(
+                    [self._slots.get(int(k), [0.0])[0] for k in keys],
+                    np.float32)
+            elif self.optimizer == "adam":
+                z = np.zeros(self.dim, np.float32)
+                st["slot_m"] = (np.stack(
+                    [np.asarray(self._slots.get(int(k), [z, z, 0])[0])
+                     for k in keys]) if keys.size
+                    else np.zeros((0, self.dim), np.float32))
+                st["slot_v"] = (np.stack(
+                    [np.asarray(self._slots.get(int(k), [z, z, 0])[1])
+                     for k in keys]) if keys.size
+                    else np.zeros((0, self.dim), np.float32))
+                st["slot_step"] = np.asarray(
+                    [self._slots.get(int(k), [z, z, 0])[2] for k in keys],
+                    np.int64)
+            return st
+
+    def load_state(self, st):
+        with self._lock:
+            self._rows = {int(k): st["rows"][i].astype(np.float32)
+                          for i, k in enumerate(st["keys"])}
+            self._meta = {int(k): list(st["meta"][i])
+                          for i, k in enumerate(st["keys"])}
+            self._slots = {}
+            opt = str(st.get("optimizer", "sgd"))
+            if opt == self.optimizer == "adagrad" and "slot_g2" in st:
+                self._slots = {int(k): [float(st["slot_g2"][i])]
+                               for i, k in enumerate(st["keys"])}
+            elif opt == self.optimizer == "adam" and "slot_m" in st:
+                self._slots = {
+                    int(k): [st["slot_m"][i].astype(np.float32),
+                             st["slot_v"][i].astype(np.float32),
+                             int(st["slot_step"][i])]
+                    for i, k in enumerate(st["keys"])}
+
+    def apply_delta(self, ids, deltas):
+        """Subtract raw deltas (GeoSGD merge — bypasses the optimizer rule,
+        reference communicator.cc Geo applies summed deltas directly)."""
+        with self._lock:
+            for k, d in zip(np.asarray(ids).ravel(),
+                            np.asarray(deltas, np.float32)):
+                self._rows[int(k)] = self._row(int(k)) - d
 
 
 class DenseTable:
@@ -81,12 +255,20 @@ class DenseTable:
             self.value -= (lr if lr is not None else self.lr) * np.asarray(
                 grad, np.float32)
 
+    def state(self):
+        with self._lock:
+            return {"value": self.value.copy()}
+
+    def load_state(self, st):
+        with self._lock:
+            self.value = np.asarray(st["value"], np.float32)
+
 
 _tables = {}
-_server_worker = None  # rpc worker name hosting the tables; None = local
+_server_workers = None  # rpc worker names hosting shards; None = local
 
 
-# -- server-side functions (invoked via rpc on the server rank) -------------
+# -- server-side functions (invoked via rpc on the server ranks) -------------
 
 def _srv_create(name, kind, **kwargs):
     _tables[name] = (SparseTable(**kwargs) if kind == "sparse"
@@ -94,8 +276,13 @@ def _srv_create(name, kind, **kwargs):
     return True
 
 
-def _srv_pull_sparse(name, ids):
-    return _tables[name].pull(ids)
+def _srv_pull_sparse(name, ids, clicks=None, record_show=True):
+    return _tables[name].pull(ids, clicks, record_show)
+
+
+def _srv_apply_delta(name, ids, deltas):
+    _tables[name].apply_delta(ids, deltas)
+    return True
 
 
 def _srv_push_sparse(name, ids, grads, lr=None):
@@ -112,41 +299,75 @@ def _srv_push_dense(name, grad, lr=None):
     return True
 
 
+def _srv_shrink(name, threshold):
+    return _tables[name].shrink(threshold)
+
+
+def _srv_state(name):
+    return _tables[name].state()
+
+
+def _srv_load_state(name, st):
+    _tables[name].load_state(st)
+    return True
+
+
+def _srv_size(name):
+    return _tables[name].size()
+
+
 def _srv_shutdown():
     _tables.clear()
     return True
 
 
-def _call(fn, *args, **kwargs):
-    if _server_worker is None:
+def _call_on(worker, fn, *args, **kwargs):
+    if worker is None:
         return fn(*args, **kwargs)
     from paddle_tpu.distributed import rpc
 
-    return rpc.rpc_sync(_server_worker, fn, args=args, kwargs=kwargs)
+    return rpc.rpc_sync(worker, fn, args=args, kwargs=kwargs)
+
+
+def _shard_of(key):
+    """Key routing across server shards (reference ps_client's
+    `sparse_local_shard_num` hashing)."""
+    if not _server_workers:
+        return None
+    return _server_workers[int(key) % len(_server_workers)]
 
 
 # -- public API --------------------------------------------------------------
 
-def init_server(tables, server_worker=None):
-    """tables: {name: {"kind": "sparse"|"dense", ...SparseTable/DenseTable
-    kwargs}}. With server_worker set (an rpc worker name from init_rpc),
-    tables are created THERE and all pulls/pushes route over rpc; without
-    it, tables are process-local (single-machine mode)."""
-    global _server_worker
-    _server_worker = server_worker
+def init_server(tables, server_worker=None, server_workers=None):
+    """tables: {name: {"kind": "sparse"|"dense", ...table kwargs}}.
+    server_workers: list of rpc worker names — tables are created on EVERY
+    server and sparse rows route to hash(key) % n_servers (the reference's
+    multi-PServer sharding). server_worker (singular) keeps the one-server
+    form. Without either, tables are process-local."""
+    global _server_workers
+    if server_workers is not None:
+        _server_workers = list(server_workers)
+    elif server_worker is not None:
+        _server_workers = [server_worker]
+    else:
+        _server_workers = None
+    targets = _server_workers or [None]
     for name, cfg in tables.items():
         cfg = dict(cfg)
         kind = cfg.pop("kind", "sparse")
-        _call(_srv_create, name, kind, **cfg)
+        for w in targets:
+            _call_on(w, _srv_create, name, kind, **cfg)
 
 
 def shutdown_server():
     """Clears the tables WHERE THEY LIVE (over rpc in server mode), then
     detaches — server-side GBs of rows must not outlive the job."""
-    global _server_worker
-    _call(_srv_shutdown)
+    global _server_workers
+    for w in (_server_workers or [None]):
+        _call_on(w, _srv_shutdown)
     _tables.clear()
-    _server_worker = None
+    _server_workers = None
 
 
 def get_table(name):
@@ -154,20 +375,212 @@ def get_table(name):
     return _tables.get(name)
 
 
-def pull_sparse(name, ids):
-    """Fetch embedding rows for ids -> np.ndarray [len(ids), dim]."""
-    return _call(_srv_pull_sparse, name, np.asarray(ids))
+def pull_sparse(name, ids, clicks=None):
+    """Fetch embedding rows for ids -> np.ndarray [len(ids), dim]; rows
+    route to their hash shard in multi-server mode."""
+    ids = np.asarray(ids)
+    if not _server_workers or len(_server_workers) == 1:
+        w = _server_workers[0] if _server_workers else None
+        return _call_on(w, _srv_pull_sparse, name, ids, clicks)
+    flat = ids.ravel()
+    if flat.size == 0:  # shape (0, dim) must match the 1-server path
+        return _call_on(_server_workers[0], _srv_pull_sparse, name, flat,
+                        None)
+    parts = {}
+    for i, k in enumerate(flat):
+        parts.setdefault(_shard_of(k), []).append(i)
+    rows = [None] * flat.size
+    for w, idxs in parts.items():
+        got = _call_on(w, _srv_pull_sparse, name, flat[idxs],
+                       None if clicks is None
+                       else np.asarray(clicks).ravel()[idxs])
+        for j, i in enumerate(idxs):
+            rows[i] = got[j]
+    return np.stack(rows)
 
 
 def push_sparse(name, ids, grads, lr=None):
-    """Apply SGD on the server rows: row[k] -= lr * grad."""
-    return _call(_srv_push_sparse, name, np.asarray(ids),
-                 np.asarray(grads, np.float32), lr)
+    """Apply the table's sparse optimizer on the server rows."""
+    ids = np.asarray(ids)
+    grads = np.asarray(grads, np.float32)
+    if not _server_workers or len(_server_workers) == 1:
+        w = _server_workers[0] if _server_workers else None
+        return _call_on(w, _srv_push_sparse, name, ids, grads, lr)
+    flat = ids.ravel()
+    parts = {}
+    for i, k in enumerate(flat):
+        parts.setdefault(_shard_of(k), []).append(i)
+    for w, idxs in parts.items():
+        _call_on(w, _srv_push_sparse, name, flat[idxs], grads[idxs], lr)
+    return True
 
 
 def pull_dense(name):
-    return _call(_srv_pull_dense, name)
+    w = _server_workers[0] if _server_workers else None
+    return _call_on(w, _srv_pull_dense, name)
 
 
 def push_dense(name, grad, lr=None):
-    return _call(_srv_push_dense, name, np.asarray(grad, np.float32), lr)
+    w = _server_workers[0] if _server_workers else None
+    return _call_on(w, _srv_push_dense, name,
+                    np.asarray(grad, np.float32), lr)
+
+
+def shrink(name, threshold=1.0):
+    """Evict cold rows on every shard; returns total evicted."""
+    return sum(_call_on(w, _srv_shrink, name, threshold)
+               for w in (_server_workers or [None]))
+
+
+def save_tables(path, names=None):
+    """Persist tables to `path` (one npz per table per shard — the
+    reference's table save RPC fan-out)."""
+    os.makedirs(path, exist_ok=True)
+    workers = _server_workers or [None]
+    names = names if names is not None else (
+        list(_tables) if not _server_workers else names)
+    if names is None:
+        raise ValueError("multi-server save needs explicit table names")
+    for name in names:
+        for si, w in enumerate(workers):
+            st = _call_on(w, _srv_state, name)
+            np.savez(os.path.join(path, f"{name}.shard{si}.npz"), **st)
+
+
+def load_tables(path, names=None):
+    """Load tables saved by save_tables. The saved shard count may differ
+    from the current server count: ALL saved shards are read, merged, and
+    re-sharded by the CURRENT hash routing (the reference's load with
+    changed pserver count re-distributes rows the same way)."""
+    workers = _server_workers or [None]
+    if names is None:
+        names = sorted({f.split(".shard")[0] for f in os.listdir(path)
+                        if ".shard" in f})
+    for name in names:
+        shard_files = sorted(
+            f for f in os.listdir(path)
+            if f.startswith(name + ".shard") and f.endswith(".npz"))
+        if not shard_files:
+            raise FileNotFoundError(f"no shards for table {name} in {path}")
+        states = [dict(np.load(os.path.join(path, f))) for f in shard_files]
+        if "value" in states[0]:  # dense table: single logical state
+            _call_on(workers[0], _srv_load_state, name, states[0])
+            continue
+        merged = _merge_sparse_states(states)
+        if len(workers) == 1:
+            _call_on(workers[0], _srv_load_state, name, merged)
+            continue
+        for wi, w in enumerate(workers):
+            sel = np.asarray([i for i, k in enumerate(merged["keys"])
+                              if int(k) % len(workers) == wi], np.int64)
+            _call_on(w, _srv_load_state, name,
+                     {k2: v[sel] for k2, v in merged.items()
+                      if isinstance(v, np.ndarray)}
+                     | {"optimizer": merged.get("optimizer", "sgd")})
+
+
+def _merge_sparse_states(states):
+    """Concatenate per-shard sparse states into one logical table state."""
+    out = {}
+    arr_keys = [k for k in states[0] if isinstance(states[0][k], np.ndarray)
+                and states[0][k].ndim >= 1]
+    for k in arr_keys:
+        out[k] = np.concatenate([st[k] for st in states])
+    opt = states[0].get("optimizer", "sgd")
+    out["optimizer"] = (opt.item() if hasattr(opt, "item") else opt)
+    return out
+
+
+def _geo_apply_delta(name, ids, deltas):
+    ids = np.asarray(ids)
+    deltas = np.asarray(deltas, np.float32)
+    if not _server_workers or len(_server_workers) == 1:
+        w = _server_workers[0] if _server_workers else None
+        return _call_on(w, _srv_apply_delta, name, ids, deltas)
+    flat = ids.ravel()
+    parts = {}
+    for i, k in enumerate(flat):
+        parts.setdefault(_shard_of(k), []).append(i)
+    for w, idxs in parts.items():
+        _call_on(w, _srv_apply_delta, name, flat[idxs], deltas[idxs])
+    return True
+
+
+def _pull_no_show(name, ids):
+    ids = np.asarray(ids)
+    if not _server_workers or len(_server_workers) == 1:
+        w = _server_workers[0] if _server_workers else None
+        return _call_on(w, _srv_pull_sparse, name, ids, None, False)
+    flat = ids.ravel()
+    if flat.size == 0:
+        return _call_on(_server_workers[0], _srv_pull_sparse, name, flat,
+                        None, False)
+    parts = {}
+    for i, k in enumerate(flat):
+        parts.setdefault(_shard_of(k), []).append(i)
+    rows = [None] * flat.size
+    for w, idxs in parts.items():
+        got = _call_on(w, _srv_pull_sparse, name, flat[idxs], None, False)
+        for j, i in enumerate(idxs):
+            rows[i] = got[j]
+    return np.stack(rows)
+
+
+class GeoSparseCache:
+    """GeoSGD async mode (reference `ps/service/communicator.cc` Geo): the
+    trainer applies updates to a LOCAL row cache and pushes accumulated
+    deltas to the server every `k_steps`; pulls refresh the cache."""
+
+    def __init__(self, name, dim, k_steps=4, lr=0.05):
+        self.name = name
+        self.dim = dim
+        self.k_steps = k_steps
+        self.lr = lr
+        self._cache = {}
+        self._delta = {}
+        self._step = 0
+
+    def pull(self, ids):
+        keys = np.asarray(ids).ravel()
+        missing = [k for k in keys if int(k) not in self._cache]
+        if missing:
+            rows = pull_sparse(self.name, np.asarray(missing))
+            for k, r in zip(missing, rows):
+                self._cache[int(k)] = r.copy()
+        return np.stack([self._cache[int(k)] for k in keys])
+
+    def push(self, ids, grads):
+        """Local SGD apply + delta accumulation; auto-syncs every k_steps.
+        Ids never pulled locally are fetched first (lazy, matching the
+        server table's lazy row creation)."""
+        keys = np.asarray(ids).ravel()
+        missing = np.asarray([k for k in keys if int(k) not in self._cache],
+                             np.int64)
+        if missing.size:
+            self.pull(missing)
+        for k, g in zip(keys, np.asarray(grads)):
+            k = int(k)
+            upd = self.lr * np.asarray(g, np.float32)
+            self._cache[k] = self._cache[k] - upd
+            self._delta[k] = self._delta.get(
+                k, np.zeros(self.dim, np.float32)) + upd
+        self._step += 1
+        if self._step % self.k_steps == 0:
+            self.sync()
+
+    def sync(self):
+        """Apply accumulated deltas on the server via the RAW-delta path
+        (bypassing the table's optimizer rule — Geo deltas are already
+        optimizer-applied locally; feeding them through adam/adagrad would
+        renormalize them into something unrelated)."""
+        if not self._delta:
+            return
+        keys = np.asarray(sorted(self._delta), np.int64)
+        deltas = np.stack([self._delta[int(k)] for k in keys])
+        _geo_apply_delta(self.name, keys, deltas)
+        self._delta.clear()
+        # refresh cache from authoritative rows; transport pull — does NOT
+        # count as a show (CTR stats track impressions, not traffic)
+        rows = _pull_no_show(self.name, keys)
+        for k, r in zip(keys, rows):
+            self._cache[int(k)] = r.copy()

@@ -215,6 +215,14 @@ def shutdown():
     if _state.store is not None:
         _state.store.barrier("rpc/shutdown", rank=_state.rank,
                              world_size=_state.world_size)
+        # ack phase: rank 0 HOSTS the store; if it tears the master down the
+        # instant its own barrier releases, slower ranks' release polls hit
+        # a dead socket and report a spurious timeout. Every rank marks the
+        # release it observed; the master waits for all marks before dying.
+        _state.store.set(f"rpc/shutdown_done/{_state.rank}", b"1")
+        if _state.rank == 0:
+            for r in range(_state.world_size):
+                _state.store.get(f"rpc/shutdown_done/{r}", timeout=30.0)
     try:
         _state.server.close()
     except OSError:

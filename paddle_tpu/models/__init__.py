@@ -26,3 +26,7 @@ from paddle_tpu.models.bert import (  # noqa: F401
     bert_base, bert_tiny,
 )
 from paddle_tpu.models.unet import UNetModel, unet_sd_like, unet_tiny  # noqa: F401
+from paddle_tpu.models.gpt import (  # noqa: F401
+    GPTConfig, GPTForCausalLM, GPTModel, GPTPretrainingLoss,
+    gpt_pipeline_descs, gpt_tiny,
+)

@@ -1,0 +1,173 @@
+"""GPT model family (reference: the fleet GPT hybrid-parallel examples —
+`test/collective/fleet/hybrid_parallel_sharding_model.py` GPT blocks,
+PaddleNLP's gpt modeling served on the reference stack; the
+SharedLayerDesc tied-embedding idiom from
+`fleet/meta_parallel/parallel_layers/pp_layers.py:77`).
+
+Decoder-only causal LM with TIED input/output embeddings — the standard
+GPT-2 weight layout — built from the framework's own nn layers so it
+trains eager, through `paddle.Model`, the compiled `Engine`, and (the
+point of this family) through `PipelineEngine` with the embedding shared
+across the first and last pipeline stages via `SharedLayerDesc`: one
+logical parameter, AD-summed tied gradients, no broadcast/allreduce pair.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingLoss",
+           "GPTEmbeddings", "gpt_pipeline_descs", "gpt_tiny"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, max_position_embeddings=1024,
+                 hidden_dropout_prob=0.1, layer_norm_eps=1e-5):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.layer_norm_eps = layer_norm_eps
+
+
+class GPTEmbeddings(nn.Layer):
+    """Token + learned position embeddings; `word_embeddings.weight` is the
+    tied output projection."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = paddle.arange(s, dtype="int32")
+        return self.dropout(self.word_embeddings(input_ids)
+                            + self.position_embeddings(pos))
+
+
+class GPTDecoderLayer(nn.Layer):
+    """Pre-LN causal transformer block (GPT-2 layout)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = nn.MultiHeadAttention(cfg.hidden_size,
+                                          cfg.num_attention_heads,
+                                          dropout=cfg.hidden_dropout_prob)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.act = nn.GELU()
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x):
+        s = x.shape[1]
+        # causal mask: -inf above the diagonal (additive attn mask)
+        mask = paddle.to_tensor(
+            np.triu(np.full((s, s), -1e9, "float32"), k=1))
+        h = self.ln1(x)
+        x = x + self.attn(h, h, h, attn_mask=mask)
+        h = self.ln2(x)
+        return x + self.dropout(self.fc2(self.act(self.fc1(h))))
+
+
+class GPTFinalNorm(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, x):
+        return self.ln_f(x)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.layers = nn.LayerList(
+            [GPTDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.final = GPTFinalNorm(cfg)
+
+    def forward(self, input_ids):
+        x = self.embeddings(input_ids)
+        for layer in self.layers:
+            x = layer(x)
+        return self.final(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    """Eager tied-LM: logits = h @ word_embeddings.weight^T (one parameter,
+    both uses — the same tying PipelineEngine expresses with
+    SharedLayerDesc across stages)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+
+    def forward(self, input_ids, labels=None):
+        h = self.gpt(input_ids)
+        logits = paddle.matmul(
+            h, self.gpt.embeddings.word_embeddings.weight, transpose_y=True)
+        if labels is not None:
+            return GPTPretrainingLoss()(logits, labels)
+        return logits
+
+
+class GPTPretrainingLoss(nn.Layer):
+    """Next-token CE with the shift INSIDE the loss: pass labels ==
+    input_ids and the loss trains position t to predict token t+1
+    (logits[:, :-1] vs labels[:, 1:]). Do NOT pre-shift labels — they
+    would be shifted twice. Padding positions use ignore_index -100."""
+
+    def forward(self, logits, labels):
+        import paddle_tpu.nn.functional as F
+
+        lg = logits[:, :-1, :]
+        lb = labels[:, 1:]
+        return F.cross_entropy(
+            lg.reshape([-1, lg.shape[-1]]), lb.reshape([-1]),
+            ignore_index=-100)
+
+
+def _tied_head_forward(layer, h):
+    """SharedLayerDesc forward_func for the output-projection occurrence of
+    the shared embedding layer."""
+    return paddle.matmul(h, layer.word_embeddings.weight, transpose_y=True)
+
+
+def gpt_pipeline_descs(cfg: GPTConfig):
+    """SharedLayerDesc stack for `PipelineLayer`: the embedding appears on
+    the FIRST stage (token lookup) and the LAST stage (tied output
+    projection) under one key (reference pp_layers.py:77); the decoder
+    body segments across stages."""
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
+        LayerDesc, SharedLayerDesc)
+
+    descs = [SharedLayerDesc("embed", GPTEmbeddings, None,
+                             "word_embeddings.weight", cfg)]
+    descs += [LayerDesc(GPTDecoderLayer, cfg)
+              for _ in range(cfg.num_hidden_layers)]
+    descs.append(LayerDesc(GPTFinalNorm, cfg))
+    descs.append(SharedLayerDesc("embed", GPTEmbeddings, _tied_head_forward,
+                                 "word_embeddings.weight", cfg))
+    return descs
+
+
+def gpt_tiny(**kwargs):
+    cfg = dict(vocab_size=512, hidden_size=64, num_hidden_layers=4,
+               num_attention_heads=4, intermediate_size=128,
+               max_position_embeddings=128, hidden_dropout_prob=0.0)
+    cfg.update(kwargs)
+    return GPTForCausalLM(GPTConfig(**cfg))

@@ -366,3 +366,38 @@ def test_uneven_body_trains():
     labels = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int64)
     losses = [float(eng.train_batch([ids], [labels])) for _ in range(6)]
     assert losses[-1] < losses[0], losses
+
+
+# --------------------------------------------------------------------------
+# GPT family: tied embeddings through the compiled pipeline
+# --------------------------------------------------------------------------
+
+def test_gpt_tied_pipeline_parity_and_training():
+    """GPT (decoder-only, TIED input/output embedding via SharedLayerDesc)
+    at dp=2 x pp=2: loss parity vs the single-device eager PipelineLayer,
+    tied param appears once in the flat tree, and training reduces loss —
+    the standard GPT-2 weight layout through the compiled pipeline
+    (VERDICT r3 item 4's real-model case)."""
+    from paddle_tpu.models.gpt import (GPTConfig, GPTPretrainingLoss,
+                                       gpt_pipeline_descs)
+
+    cfg = GPTConfig(vocab_size=256, hidden_size=32, num_hidden_layers=4,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0)
+    pipe = PipelineLayer(layers=gpt_pipeline_descs(cfg), num_stages=2,
+                         loss_fn=GPTPretrainingLoss())
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (8, 16)).astype(np.int32)
+    labels = ids.astype(np.int64)
+
+    opt = paddle.optimizer.AdamW(learning_rate=2e-3,
+                                 parameters=pipe.parameters())
+    eng = PipelineEngine(pipe, optimizer=opt, dp=2, pp=2, mp=1,
+                         micro_batches=2)
+    loss, grads = eng.loss_and_grads([ids], [labels])
+    assert "shared.embed.word_embeddings.weight" in grads
+    ref = _eager_ref_loss(pipe, GPTPretrainingLoss(), [ids], [labels], 2)
+    np.testing.assert_allclose(float(loss), ref, rtol=2e-4)
+
+    losses = [float(eng.train_batch([ids], [labels])) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
