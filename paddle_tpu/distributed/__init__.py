@@ -39,7 +39,9 @@ from paddle_tpu.distributed.engine import Engine  # noqa: F401
 from paddle_tpu.distributed.pipeline_engine import (  # noqa: F401
     PipelineEngine, transformer_mp_spec,
 )
-from paddle_tpu.distributed.ring_attention import ring_attention  # noqa: F401
+from paddle_tpu.distributed.ring_attention import (  # noqa: F401
+    ring_attention, ulysses_attention,
+)
 
 
 import importlib as _importlib

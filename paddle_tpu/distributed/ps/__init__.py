@@ -132,14 +132,15 @@ class SparseTable:
         keys = np.asarray(ids).ravel()
         if keys.size == 0:  # empty feature batch: valid in sparse workloads
             return np.zeros((0, self.dim), np.float32)
+        cl = np.asarray(clicks).ravel() if clicks is not None else None
         with self._lock:
             out = np.stack([self._row(k) for k in keys])
             if record_show:
                 for i, k in enumerate(keys):
                     m = self._meta[int(k)]
                     m[0] += 1.0
-                    if clicks is not None:
-                        m[1] += float(np.asarray(clicks).ravel()[i])
+                    if cl is not None:
+                        m[1] += float(cl[i])
             return out
 
     def push(self, ids, grads, lr=None):
@@ -316,6 +317,10 @@ def _srv_size(name):
     return _tables[name].size()
 
 
+def _srv_list_tables():
+    return sorted(_tables)
+
+
 def _srv_shutdown():
     _tables.clear()
     return True
@@ -337,6 +342,45 @@ def _shard_of(key):
     return _server_workers[int(key) % len(_server_workers)]
 
 
+def _fanout(srv_fn, name, ids, row_extras=(), extra_args=(), gather=True):
+    """Route per-row calls to their hash shards — dispatched ASYNC across
+    shards (one in-flight rpc per server, reference ps_client's parallel
+    region requests), results reassembled in input order.
+
+    row_extras: arrays aligned with ids, sliced per shard (grads/clicks).
+    extra_args: scalars appended to every shard call (lr, flags)."""
+    ids = np.asarray(ids)
+    flat = ids.ravel()
+    if not _server_workers or len(_server_workers) == 1:
+        w = _server_workers[0] if _server_workers else None
+        return _call_on(w, srv_fn, name, flat,
+                        *[e for e in row_extras], *extra_args)
+    if flat.size == 0:  # shape must match the 1-server path ((0, dim) pulls)
+        return _call_on(_server_workers[0], srv_fn, name, flat,
+                        *[e for e in row_extras], *extra_args)
+    parts = {}
+    for i, k in enumerate(flat):
+        parts.setdefault(_shard_of(k), []).append(i)
+    from paddle_tpu.distributed import rpc as _rpc
+
+    futs = []
+    for w, idxs in parts.items():
+        sliced = [None if e is None else np.asarray(e)[idxs]
+                  for e in row_extras]
+        futs.append((idxs, _rpc.rpc_async(
+            w, srv_fn, args=(name, flat[idxs], *sliced, *extra_args))))
+    if not gather:
+        for _, f in futs:
+            f.wait()
+        return True
+    rows = [None] * flat.size
+    for idxs, f in futs:
+        got = f.wait()
+        for j, i in enumerate(idxs):
+            rows[i] = got[j]
+    return np.stack(rows)
+
+
 # -- public API --------------------------------------------------------------
 
 def init_server(tables, server_worker=None, server_workers=None):
@@ -356,8 +400,13 @@ def init_server(tables, server_worker=None, server_workers=None):
     for name, cfg in tables.items():
         cfg = dict(cfg)
         kind = cfg.pop("kind", "sparse")
-        for w in targets:
-            _call_on(w, _srv_create, name, kind, **cfg)
+        if kind == "dense":
+            # dense tables have one logical copy: shard 0 only (pull_dense/
+            # push_dense route there; replicas would just go stale)
+            _call_on(targets[0], _srv_create, name, kind, **cfg)
+        else:
+            for w in targets:
+                _call_on(w, _srv_create, name, kind, **cfg)
 
 
 def shutdown_server():
@@ -378,41 +427,15 @@ def get_table(name):
 def pull_sparse(name, ids, clicks=None):
     """Fetch embedding rows for ids -> np.ndarray [len(ids), dim]; rows
     route to their hash shard in multi-server mode."""
-    ids = np.asarray(ids)
-    if not _server_workers or len(_server_workers) == 1:
-        w = _server_workers[0] if _server_workers else None
-        return _call_on(w, _srv_pull_sparse, name, ids, clicks)
-    flat = ids.ravel()
-    if flat.size == 0:  # shape (0, dim) must match the 1-server path
-        return _call_on(_server_workers[0], _srv_pull_sparse, name, flat,
-                        None)
-    parts = {}
-    for i, k in enumerate(flat):
-        parts.setdefault(_shard_of(k), []).append(i)
-    rows = [None] * flat.size
-    for w, idxs in parts.items():
-        got = _call_on(w, _srv_pull_sparse, name, flat[idxs],
-                       None if clicks is None
-                       else np.asarray(clicks).ravel()[idxs])
-        for j, i in enumerate(idxs):
-            rows[i] = got[j]
-    return np.stack(rows)
+    cl = None if clicks is None else np.asarray(clicks).ravel()
+    return _fanout(_srv_pull_sparse, name, ids, row_extras=(cl,))
 
 
 def push_sparse(name, ids, grads, lr=None):
     """Apply the table's sparse optimizer on the server rows."""
-    ids = np.asarray(ids)
-    grads = np.asarray(grads, np.float32)
-    if not _server_workers or len(_server_workers) == 1:
-        w = _server_workers[0] if _server_workers else None
-        return _call_on(w, _srv_push_sparse, name, ids, grads, lr)
-    flat = ids.ravel()
-    parts = {}
-    for i, k in enumerate(flat):
-        parts.setdefault(_shard_of(k), []).append(i)
-    for w, idxs in parts.items():
-        _call_on(w, _srv_push_sparse, name, flat[idxs], grads[idxs], lr)
-    return True
+    return _fanout(_srv_push_sparse, name, ids,
+                   row_extras=(np.asarray(grads, np.float32),),
+                   extra_args=(lr,), gather=False)
 
 
 def pull_dense(name):
@@ -434,16 +457,20 @@ def shrink(name, threshold=1.0):
 
 def save_tables(path, names=None):
     """Persist tables to `path` (one npz per table per shard — the
-    reference's table save RPC fan-out)."""
+    reference's table save RPC fan-out). Without explicit names the
+    server(s) are ASKED what they host (works in local, single-server rpc,
+    and sharded modes)."""
     os.makedirs(path, exist_ok=True)
     workers = _server_workers or [None]
-    names = names if names is not None else (
-        list(_tables) if not _server_workers else names)
     if names is None:
-        raise ValueError("multi-server save needs explicit table names")
+        names = sorted({n for w in workers
+                        for n in _call_on(w, _srv_list_tables)})
     for name in names:
         for si, w in enumerate(workers):
-            st = _call_on(w, _srv_state, name)
+            try:
+                st = _call_on(w, _srv_state, name)
+            except KeyError:
+                continue  # dense tables live on shard 0 only
             np.savez(os.path.join(path, f"{name}.shard{si}.npz"), **st)
 
 
@@ -492,38 +519,14 @@ def _merge_sparse_states(states):
 
 
 def _geo_apply_delta(name, ids, deltas):
-    ids = np.asarray(ids)
-    deltas = np.asarray(deltas, np.float32)
-    if not _server_workers or len(_server_workers) == 1:
-        w = _server_workers[0] if _server_workers else None
-        return _call_on(w, _srv_apply_delta, name, ids, deltas)
-    flat = ids.ravel()
-    parts = {}
-    for i, k in enumerate(flat):
-        parts.setdefault(_shard_of(k), []).append(i)
-    for w, idxs in parts.items():
-        _call_on(w, _srv_apply_delta, name, flat[idxs], deltas[idxs])
-    return True
+    return _fanout(_srv_apply_delta, name, ids,
+                   row_extras=(np.asarray(deltas, np.float32),),
+                   gather=False)
 
 
 def _pull_no_show(name, ids):
-    ids = np.asarray(ids)
-    if not _server_workers or len(_server_workers) == 1:
-        w = _server_workers[0] if _server_workers else None
-        return _call_on(w, _srv_pull_sparse, name, ids, None, False)
-    flat = ids.ravel()
-    if flat.size == 0:
-        return _call_on(_server_workers[0], _srv_pull_sparse, name, flat,
-                        None, False)
-    parts = {}
-    for i, k in enumerate(flat):
-        parts.setdefault(_shard_of(k), []).append(i)
-    rows = [None] * flat.size
-    for w, idxs in parts.items():
-        got = _call_on(w, _srv_pull_sparse, name, flat[idxs], None, False)
-        for j, i in enumerate(idxs):
-            rows[i] = got[j]
-    return np.stack(rows)
+    return _fanout(_srv_pull_sparse, name, ids, row_extras=(None,),
+                   extra_args=(False,))
 
 
 class GeoSparseCache:

@@ -36,7 +36,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["ring_attention"]
+__all__ = ["ring_attention", "ulysses_attention"]
 
 
 def _full_block(q, k, v, fa, sm_scale, causal, interpret=False):
@@ -143,3 +143,62 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
     (_, _, out, _), _ = jax.lax.scan(step, (k, v, out0, lse0),
                                      jnp.arange(P))
     return out
+
+
+def ulysses_attention(q, k, v, axis_name, causal=True, sm_scale=None,
+                      interpret=False):
+    """DeepSpeed-Ulysses-style sequence parallelism ("Ulysses: System
+    Optimizations for Enabling Long-Sequence Transformer Training",
+    Jacobs et al., 2023 — public recipe; the reference snapshot has no
+    equivalent): q/k/v arrive SEQUENCE-sharded [b, s/P, h, d] over
+    `axis_name`; one all_to_all re-shards them to HEAD-sharded
+    [b, s, h/P, d], every rank runs ordinary (flash) attention over the
+    FULL sequence for its head group, and the inverse all_to_all restores
+    sequence sharding.
+
+    vs ring attention: two all_to_alls of O(s*h/P) per call instead of P
+    ppermute hops of O(s/P * h); causal balance is perfect (each rank owns
+    whole heads, not sequence slices), but P must divide num_heads and
+    peak activation is O(s) per rank (full-sequence attention per head
+    group). Prefer ulysses when heads >> P and the ICI all_to_all is
+    cheap; ring when sequence alone must scale past per-rank memory.
+
+    Use inside shard_map with the seq dim sharded over `axis_name`:
+
+        out = ulysses_attention(q, k, v, axis_name="sp", causal=True)
+
+    Backward is jax AD (all_to_all transposes to the inverse all_to_all).
+    """
+    from paddle_tpu.kernels import flash_attention as fa
+    from paddle_tpu.nn.functional.flash_attention import _sdpa_reference
+
+    P = jax.lax.axis_size(axis_name)
+    h, hk = q.shape[2], k.shape[2]
+    if h % P != 0 or hk % P != 0:
+        raise ValueError(
+            f"ulysses needs q heads ({h}) AND kv heads ({hk}) divisible by "
+            f"the '{axis_name}' axis size ({P}); for GQA with few kv heads "
+            "use ring_attention instead")
+
+    def seq_to_heads(x):
+        # [b, s/P, h, d] -> [b, s, h/P, d]
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = None
+    if (interpret or jax.default_backend() == "tpu") and fa.supports(
+            qh.shape, kh.shape, qh.dtype.itemsize):
+        try:
+            out = fa.flash_attention_fwd(qh, kh, vh, causal=causal,
+                                         scale=sm_scale,
+                                         interpret=interpret)
+        except Exception:  # unsupported tiling: fused-XLA fallback
+            out = None
+    if out is None:
+        out = _sdpa_reference(qh, kh, vh, causal=causal, scale=sm_scale)
+    return heads_to_seq(out)

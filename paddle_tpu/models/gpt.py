@@ -14,8 +14,6 @@ logical parameter, AD-summed tied gradients, no broadcast/allreduce pair.
 
 from __future__ import annotations
 
-import numpy as np
-
 import paddle_tpu as paddle
 from paddle_tpu import nn
 
@@ -73,9 +71,11 @@ class GPTDecoderLayer(nn.Layer):
 
     def forward(self, x):
         s = x.shape[1]
-        # causal mask: -inf above the diagonal (additive attn mask)
-        mask = paddle.to_tensor(
-            np.triu(np.full((s, s), -1e9, "float32"), k=1))
+        # causal mask built ON DEVICE inside the op graph (XLA folds the
+        # constant; no per-layer host alloc + h2d, and no cached device
+        # array for a later export to lift into an argument)
+        mask = paddle.triu(paddle.full([s, s], -1e9, dtype="float32"),
+                           diagonal=1)
         h = self.ln1(x)
         x = x + self.attn(h, h, h, attn_mask=mask)
         h = self.ln2(x)
