@@ -200,10 +200,26 @@ def _bench_int8(steps=32, warmup=4):
 
 
 def main():
+    # the axon tunnel blocks indefinitely while another (possibly dead)
+    # claimant wedges the claim; emit a diagnostic line instead of hanging
+    # the driver forever
+    import signal
+
+    def _stuck(signum, frame):
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec", "value": 0,
+            "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+            "error": "TPU backend init did not complete within 600s "
+                     "(tunnel claim wedged?)"}), flush=True)
+        os._exit(1)
+
+    signal.signal(signal.SIGALRM, _stuck)
+    signal.alarm(600)
     import jax
 
     backend = jax.default_backend()
     kind = jax.devices()[0].device_kind if jax.devices() else "cpu"
+    signal.alarm(0)
     peak = _peak_for(kind) if backend == "tpu" else None
 
     results = []

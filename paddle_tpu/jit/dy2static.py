@@ -32,6 +32,7 @@ from __future__ import annotations
 import ast
 import functools
 import inspect
+import operator
 import textwrap
 import types
 
@@ -42,8 +43,8 @@ from paddle_tpu.core.tensor import Tensor
 
 __all__ = [
     "convert_function", "converted_layer_call", "convert_ifelse",
-    "convert_while", "convert_logical_and", "convert_logical_or",
-    "convert_logical_not", "Dy2StaticFallback",
+    "convert_while", "convert_for_range", "convert_logical_and",
+    "convert_logical_or", "convert_logical_not", "Dy2StaticFallback",
 ]
 
 _RUNTIME_NAME = "__pt_jst__"
@@ -127,7 +128,8 @@ def convert_while(cond_fn, body_fn, init):
     Traced condition: `lax.while_loop` with the variables as carry (they
     are fixed to their traced shapes/dtypes). Concrete: Python loop."""
     first = cond_fn(*init)
-    if not _is_traced(first) and not any(_is_traced(v) for v in init):
+    if not _is_traced(first) and not any(
+            _is_traced(v) for v in jax.tree.leaves(tuple(init))):
         state = tuple(init)
         c = first
         while _truthy(c):
@@ -189,6 +191,88 @@ def lookup_or_undef(local_ns, name):
     return local_ns.get(name, UNDEF)
 
 
+class RangeArgs:
+    """Normalized range(...) bounds for converted for-loops (reference
+    loop_transformer's for->while rewrite). The step must be concrete
+    (its SIGN decides the loop condition); numpy integer scalars are
+    accepted like range() accepts them (__index__)."""
+
+    __slots__ = ("start", "stop", "step")
+
+    def __init__(self, *args):
+        if len(args) == 1:
+            self.start, self.stop, self.step = 0, args[0], 1
+        elif len(args) == 2:
+            (self.start, self.stop), self.step = args, 1
+        else:
+            self.start, self.stop, self.step = args
+        if _is_traced(self.step):
+            raise Dy2StaticFallback(
+                "to_static: range() step must be a Python number in "
+                "converted for-loops (the direction decides the loop "
+                "condition)")
+        try:
+            self.step = int(operator.index(self.step))
+        except TypeError:
+            raise Dy2StaticFallback(
+                f"to_static: invalid range step {self.step!r}") from None
+        if self.step == 0:
+            raise Dy2StaticFallback("to_static: range() step must not be 0")
+
+
+def range_continue(i, r):
+    if r.step > 0:
+        return _lt(i, r.stop)
+    return _lt(r.stop, i)
+
+
+def _lt(a, b):
+    ua, ub = _unwrap(a), _unwrap(b)
+    if isinstance(ua, jax.Array) or isinstance(ub, jax.Array):
+        return Tensor(jnp.asarray(ua) < jnp.asarray(ub))
+    return ua < ub
+
+
+def range_next(i, r):
+    u = _unwrap(i)
+    if isinstance(u, jax.Array):
+        return Tensor(u + r.step)
+    return u + r.step
+
+
+# Python-unroll budget for concrete-bound for-loops with traced state: small
+# loops keep exact Python semantics (side effects, non-jax state); bigger
+# ones compile to ONE rolled lax.while_loop instead of bloating the jaxpr
+# with thousands of body copies.
+_UNROLL_LIMIT = 64
+
+
+def convert_for_range(cond_fn, body_fn, init, r):
+    """Converted `for target in range(...)`. init = (counter, target,
+    *loop_vars); counter rides the carry, target is assigned from it at
+    the top of each body (so after the loop it holds Python's LAST body
+    value, and a zero-trip loop leaves it untouched/unbound)."""
+    def lax_init():
+        # the carry needs a concrete leaf for the target; the body assigns
+        # it from the counter before any use (only the data-dependent
+        # zero-trip "target stays unbound" nuance is unexpressible)
+        st = list(init)
+        if st[1] is UNDEF:
+            st[1] = r.start
+        return tuple(st)
+
+    if _is_traced(r.stop) or _is_traced(r.start):
+        return convert_while(cond_fn, body_fn, lax_init())
+    n = len(range(int(operator.index(r.start)),
+                  int(operator.index(r.stop)), r.step))
+    if n <= _UNROLL_LIMIT:
+        state = tuple(init)
+        for _ in range(n):
+            state = tuple(body_fn(*state))
+        return state
+    return convert_while(cond_fn, body_fn, lax_init())
+
+
 def _truthy(x):
     return bool(_unwrap(x))
 
@@ -238,6 +322,8 @@ class _NameCollector(ast.NodeVisitor):
         self._seen = set()
 
     def _add(self, name):
+        if name.startswith("__pt_"):
+            return  # synthetic conversion locals: never loop/branch state
         if name not in self._seen:
             self._seen.add(name)
             self.names.append(name)
@@ -368,6 +454,31 @@ def _ctlflow(stmts):
     return f
 
 
+class _ReadCollector(ast.NodeVisitor):
+    """All names READ in a subtree (Name loads + AugAssign targets, which
+    read-modify-write). Conservative: nested function bodies count (they
+    may close over the name)."""
+
+    def __init__(self):
+        self.reads = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.reads.add(node.id)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self.reads.add(node.target.id)
+        self.generic_visit(node)
+
+
+def _reads(stmts):
+    c = _ReadCollector()
+    for s in stmts if isinstance(stmts, list) else [stmts]:
+        c.visit(s)
+    return c.reads
+
+
 def _name(id_, ctx):
     return ast.Name(id=id_, ctx=ctx)
 
@@ -394,13 +505,30 @@ class ControlFlowTransformer(ast.NodeTransformer):
 
     def __init__(self):
         self._n = 0
+        self._range_shadowed = False
+        # live-after stack: the set of names possibly READ after the
+        # statement currently being converted (branch/loop carries are
+        # restricted to live names — a dead assigned name must not force
+        # both lax.cond branches to produce it)
+        self._live = [set()]
 
     def _uid(self):
         self._n += 1
         return self._n
 
+    def _live_after(self):
+        return self._live[-1]
+
     # -- statement-list processing with `if c: return x` folding ------------
     def _process_block(self, stmts):
+        outer_live = set(self._live[-1])
+        # tails[i] = names read by statements AFTER i (plus the block's own
+        # live-after set)
+        tails = [None] * len(stmts)
+        tail = set(outer_live)
+        for i in range(len(stmts) - 1, -1, -1):
+            tails[i] = set(tail)
+            tail |= _reads(stmts[i])
         out = []
         i = 0
         while i < len(stmts):
@@ -415,9 +543,13 @@ class ControlFlowTransformer(ast.NodeTransformer):
                 orelse = list(rest) if rest \
                     else [ast.Return(value=ast.Constant(value=None))]
                 folded = ast.If(test=s.test, body=s.body, orelse=orelse)
+                self._live.append(outer_live)
                 out.extend(self._process_stmt(folded))
+                self._live.pop()
                 return out
+            self._live.append(tails[i])
             out.extend(self._process_stmt(s))
+            self._live.pop()
             i += 1
         return out
 
@@ -429,13 +561,22 @@ class ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_FunctionDef(self, node):
         node.args = self.visit(node.args)
+        prev = self._range_shadowed
+        params = {a.arg for a in node.args.args}
+        self._range_shadowed = ("range" in _assigned_names(node.body)
+                                or "range" in params)
         node.body = self._process_block(node.body)
+        self._range_shadowed = prev
         return node
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
     # -- if ------------------------------------------------------------------
     def visit_If(self, node):
+        # raw reads BEFORE conversion: the generated inner carries read
+        # their UNDEF-guarded names structurally, which must not count as
+        # pre-branch uses
+        raw_reads = _reads(node.body) | _reads(node.orelse)
         node.test = self.visit(node.test)
         node.body = self._process_block(node.body)
         node.orelse = self._process_block(node.orelse)
@@ -464,7 +605,11 @@ class ControlFlowTransformer(ast.NodeTransformer):
         if body_f.has_break_continue or else_f.has_break_continue:
             return node  # break/continue belong to an enclosing loop
 
-        names = _assigned_names(node.body + node.orelse)
+        # carry = assigned ∩ (read AFTER the if ∪ read INSIDE a branch) —
+        # branch-internal reads need the pre-branch value as a parameter
+        need = self._live_after() | raw_reads
+        names = [n for n in _assigned_names(node.body + node.orelse)
+                 if n in need]
         uid = self._uid()
         tname, fname = f"__pt_true_{uid}", f"__pt_false_{uid}"
         # branch-assigned names come IN as parameters: a name assigned in a
@@ -492,13 +637,19 @@ class ControlFlowTransformer(ast.NodeTransformer):
     # -- while ---------------------------------------------------------------
     def visit_While(self, node):
         node.test = self.visit(node.test)
+        # the loop BACK EDGE makes every body/test read live after every
+        # body statement (next iteration reads it)
+        back_edge = _reads(node.body) | _reads(node.test) | self._live_after()
+        self._live.append(back_edge)
         node.body = self._process_block(node.body)
+        self._live.pop()
         node.orelse = self._process_block(node.orelse)
 
         f = _ctlflow(node.body)
         if f.has_return or f.has_break_continue or f.has_raise or node.orelse:
             return node
-        names = _assigned_names(node.body)
+        need = back_edge  # raw body/test reads captured pre-conversion
+        names = [n for n in _assigned_names(node.body) if n in need]
         if not names:
             return node  # side-effect-only loop: nothing to carry
 
@@ -521,6 +672,84 @@ class ControlFlowTransformer(ast.NodeTransformer):
         assign = ast.Assign(targets=[_names_tuple(names, ast.Store())],
                             value=call)
         return [cdef, bdef] + guards + [assign]
+
+    # -- for-range -----------------------------------------------------------
+    def visit_For(self, node):
+        """`for i in range(...)` -> the while conversion (reference
+        loop_transformer for->while): tensor bounds become a
+        lax.while_loop; concrete bounds keep Python unrolling via
+        convert_while's Python path. Non-range iterables, tuple targets,
+        and break/continue/return bodies stay untouched."""
+        node.iter = self.visit(node.iter)
+        back_edge = (_reads(node.body) | {node.target.id}
+                     if isinstance(node.target, ast.Name)
+                     else _reads(node.body)) | self._live_after()
+        self._live.append(back_edge)
+        node.body = self._process_block(node.body)
+        self._live.pop()
+        node.orelse = self._process_block(node.orelse)
+        if self._range_shadowed:
+            return node  # user rebound `range`: leave Python semantics
+        if not (isinstance(node.iter, ast.Call)
+                and isinstance(node.iter.func, ast.Name)
+                and node.iter.func.id == "range"
+                and not node.iter.keywords
+                and 1 <= len(node.iter.args) <= 3
+                and isinstance(node.target, ast.Name)
+                and not node.orelse):
+            return node
+        f = _ctlflow(node.body)
+        if f.has_return or f.has_break_continue or f.has_raise:
+            return node
+
+        uid = self._uid()
+        tgt = node.target.id
+        rname = f"__pt_range_{uid}"
+        cname = f"__pt_i_{uid}"  # internal counter: the user target is
+        # assigned FROM it at the top of each body, so after the loop it
+        # holds Python's last body value and a zero-trip loop leaves it
+        # unbound (exact for-semantics)
+        need = back_edge  # raw body reads captured pre-conversion
+        names = [cname, tgt] + [n for n in _assigned_names(node.body)
+                                if n != tgt and n in need]
+        args = _params(names)
+        r_assign = ast.Assign(
+            targets=[_name(rname, ast.Store())],
+            value=ast.Call(func=_runtime_attr("RangeArgs"),
+                           args=list(node.iter.args), keywords=[]))
+        i_init = ast.Assign(
+            targets=[_name(cname, ast.Store())],
+            value=ast.Attribute(value=_name(rname, ast.Load()),
+                                attr="start", ctx=ast.Load()))
+        cdef = _fn_def(
+            f"__pt_fcond_{uid}", args,
+            [ast.Return(value=ast.Call(
+                func=_runtime_attr("range_continue"),
+                args=[_name(cname, ast.Load()), _name(rname, ast.Load())],
+                keywords=[]))])
+        set_tgt = ast.Assign(targets=[_name(tgt, ast.Store())],
+                             value=_name(cname, ast.Load()))
+        bump = ast.Assign(
+            targets=[_name(cname, ast.Store())],
+            value=ast.Call(func=_runtime_attr("range_next"),
+                           args=[_name(cname, ast.Load()),
+                                 _name(rname, ast.Load())],
+                           keywords=[]))
+        bdef = _fn_def(
+            f"__pt_fbody_{uid}", _copy_args(args),
+            [set_tgt] + node.body
+            + [bump, ast.Return(value=_names_tuple(names, ast.Load()))])
+        call = ast.Call(
+            func=_runtime_attr("convert_for_range"),
+            args=[_name(f"__pt_fcond_{uid}", ast.Load()),
+                  _name(f"__pt_fbody_{uid}", ast.Load()),
+                  _names_tuple(names, ast.Load()),
+                  _name(rname, ast.Load())],
+            keywords=[])
+        assign = ast.Assign(targets=[_names_tuple(names, ast.Store())],
+                            value=call)
+        return ([r_assign, i_init, cdef, bdef]
+                + _undef_guards(names[1:]) + [assign])
 
     # -- bool ops ------------------------------------------------------------
     def visit_BoolOp(self, node):

@@ -352,3 +352,182 @@ def test_hooks_survive_conversion():
     sf = to_static(layer)
     sf(_t(np.ones((2, 4))))
     assert calls  # hook observed inside the traced forward
+
+
+def test_for_range_tensor_bound_compiles():
+    """`for i in range(n)` with a TENSOR bound compiles to lax.while_loop
+    (the reference loop_transformer's for->while; eager range(Tensor)
+    would not even execute)."""
+
+    def f(x):
+        acc = paddle.zeros([1])
+        n = paddle.to_tensor(np.asarray(0, "int32")) + (x > 0).sum()
+        for i in range(n):
+            acc = acc + x.sum() * (i + 1)
+        return acc
+
+    sf = to_static(f)
+    a = _t([1.0, 2.0, -1.0])  # n = 2: acc = 2*1 + 2*2 = 6
+    assert_no_fallback(sf, (a,))
+    np.testing.assert_allclose(sf(a).numpy(), [6.0])
+    b = _t([1.0, 1.0, 1.0])  # n = 3: acc = 3*(1+2+3) = 18
+    np.testing.assert_allclose(sf(b).numpy(), [18.0])
+
+
+def test_for_range_concrete_still_python():
+    """Concrete range keeps exact Python semantics (incl. side effects)."""
+    seen = []
+
+    def f(x):
+        total = x * 0
+        for i in range(3):
+            seen.append(i)
+            total = total + x
+        return total
+
+    sf = to_static(f)
+    out = sf(_t([2.0]))
+    np.testing.assert_allclose(out.numpy(), [6.0])
+    assert seen == [0, 1, 2]
+
+
+def test_for_range_with_start_step():
+    def f(x):
+        acc = paddle.zeros([1])
+        n = (x > 0).sum() * 3  # tensor stop
+        for i in range(1, n, 2):  # 1, 3, 5 when n=6
+            acc = acc + float(1) * x.sum() * 0 + acc * 0 + i
+        return acc
+
+    sf = to_static(f)
+    a = _t([1.0, 1.0])  # n = 6 -> i in {1, 3, 5} -> acc = 9
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        out = sf(a)
+    np.testing.assert_allclose(out.numpy(), [9.0])
+
+
+def test_for_over_list_untouched():
+    """Non-range iterables keep ordinary Python iteration."""
+
+    def f(x, scales):
+        for s in scales:
+            x = x * s
+        return x
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([2.0]), [2.0, 3.0]).numpy(), [12.0])
+
+
+def test_for_range_loop_var_semantics():
+    """After the loop the target holds Python's LAST body value, not
+    one-past; a zero-trip loop leaves it unbound."""
+
+    def f(x):
+        last = None
+        for i in range(3):
+            last = x * (i + 1)
+        return last, i  # noqa: B023 - python for-semantics: i == 2
+
+    sf = to_static(f)
+    out, i = sf(_t([1.0]))
+    np.testing.assert_allclose(out.numpy(), [3.0])
+    assert int(i) == 2
+
+    def g(x):
+        for i in range(0):
+            pass
+        return i  # Python: NameError (unbound)
+
+    with pytest.raises((NameError, UnboundLocalError, Exception)):
+        to_static(g)(_t([1.0]))
+
+
+def test_for_in_traced_if_still_compiles():
+    """A concrete for-loop nested inside a traced if must not leak the
+    synthetic __pt_range name into the branch carry (would degrade to
+    eager)."""
+
+    def h(x):
+        acc = x * 0
+        if x.sum() > 0:
+            for i in range(3):
+                acc = acc + x
+        else:
+            acc = -x
+        return acc
+
+    sf = to_static(h)
+    pos, neg = _t([2.0]), _t([-2.0])
+    assert_no_fallback(sf, (pos,), (neg,))
+    np.testing.assert_allclose(sf(pos).numpy(), [6.0])
+    np.testing.assert_allclose(sf(neg).numpy(), [2.0])
+
+
+def test_branch_internal_read_keeps_prebranch_value():
+    """A name assigned in a branch AND read inside the same branch gets its
+    pre-branch value as a parameter even when dead afterwards."""
+
+    def f(x):
+        a = x
+        if x.sum() > 0:
+            a = a + 1.0
+            y = a * 2.0
+        else:
+            y = x
+        return y
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [4.0])
+    np.testing.assert_allclose(sf(_t([-1.0])).numpy(), [-1.0])
+
+
+def test_loop_back_edge_liveness():
+    """An if-assignment inside a loop whose target is read only on the NEXT
+    iteration (back edge) must stay in the branch carry."""
+
+    def f(x):
+        a = x * 0
+        b = x * 0
+        i = 0
+        while i < 3:
+            b = b + a
+            if x.sum() > 0:
+                a = x + 10.0
+            else:
+                a = x - 10.0
+            i = i + 1
+        return b
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [22.0])  # 0 + 11 + 11
+    np.testing.assert_allclose(sf(_t([-1.0])).numpy(), [-22.0])
+
+
+def test_loop_exit_flag_in_branch():
+    """`while flag: ... if t: flag = False` terminates (flag is live via
+    the loop test's back edge)."""
+
+    def f(x):
+        flag = True
+        n = 0
+        while flag:
+            n = n + 1
+            if n >= 3:
+                flag = False
+        return x * n
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([2.0])).numpy(), [6.0])
+
+
+def test_shadowed_range_keeps_python_semantics():
+    def f(x):
+        range = lambda n: [n, n]  # noqa: A001, E731
+        acc = x * 0
+        for i in range(2):
+            acc = acc + i
+        return acc
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(_t([0.0])).numpy(), [4.0])
