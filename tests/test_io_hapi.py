@@ -205,12 +205,17 @@ def test_multiprocess_loader_overlaps_input_pipeline():
         n = sum(1 for _ in loader)
         return time.monotonic() - t0, n
 
-    # best-of-2 per mode: under a loaded machine (full-suite runs) a single
-    # scheduling hiccup in either run must not flip the comparison
-    t1, n1 = min(run(0), run(0))
+    # Load-immune assertion: compare the worker run against the THEORETICAL
+    # serial floor (32 items x 20ms of mandatory sleep = 640ms). Only real
+    # overlap can beat that floor — a loaded machine slows both paths but
+    # cannot make the serial path dip under its own sleep total. best-of-2
+    # still absorbs scheduling hiccups in the parallel run.
+    t1, n1 = run(0)
     t4, n4 = min(run(4), run(4))
     assert n1 == n4 == 8
-    assert t4 < t1 * 0.7, (t1, t4)
+    serial_floor = 32 * 0.02
+    assert t1 >= serial_floor  # sanity: serial really pays the sleeps
+    assert t4 < serial_floor * 0.85, (t1, t4, serial_floor)
 
 
 def test_iterable_dataset_multiprocess():
