@@ -1,0 +1,23 @@
+"""Low-level numeric helpers shared by the eager optimizers and the
+compiled engines (no dependencies beyond jax)."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["stochastic_round_bf16"]
+
+
+def stochastic_round_bf16(key, x32):
+    """Unbiased f32 -> bf16 cast: add uniform noise to the 16 truncated
+    mantissa bits, then truncate. E[result] == x32, which is what lets a
+    bf16-stored EMA accumulate increments far below its own ulp (a plain
+    round-to-nearest bf16 second moment would silently drop every
+    (1-beta2)*g^2 increment smaller than v*2^-8)."""
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    noise = jax.random.bits(key, x32.shape, jnp.uint16).astype(jnp.uint32)
+    rounded = jax.lax.bitcast_convert_type(
+        (bits + noise) & jnp.uint32(0xFFFF0000), jnp.float32)
+    # carries into the exponent implement the rounding; only non-finite
+    # inputs must not be perturbed
+    rounded = jnp.where(jnp.isfinite(x32), rounded, x32)
+    return rounded.astype(jnp.bfloat16)

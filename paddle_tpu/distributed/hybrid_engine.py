@@ -51,33 +51,89 @@ __all__ = ["HybridParallelEngine"]
 # --------------------------------------------------------------------------
 
 
-def adamw_init(params):
-    zeros = jax.tree.map(jnp.zeros_like, params)
-    return {"m": zeros, "v": jax.tree.map(jnp.zeros_like, params),
+from paddle_tpu.core.numerics import \
+    stochastic_round_bf16 as _stochastic_round_bf16
+
+
+def _factored_leaf(shape):
+    return len(shape) >= 2
+
+
+def adamw_init(params, moments="f32"):
+    """AdamW state with selectable moment storage (the memory knob that
+    decides how much HBM is left for activations — reference keeps f32
+    moments unconditionally, `python/paddle/optimizer/adamw.py` moment1/2
+    accumulators):
+
+      - 'f32':      full-precision m and v (2 x 4 bytes/param).
+      - 'bf16':     m and v stored bf16, stochastic-rounding write-back
+                    (2 x 2 bytes/param).
+      - 'factored': m stored bf16; v replaced by Adafactor-style f32
+                    row/col EMAs of g^2 over the last two axes
+                    (~2 bytes/param total). Rank<2 leaves keep full f32 v.
+    """
+    if moments not in ("f32", "bf16", "factored"):
+        raise ValueError(f"moments must be f32|bf16|factored, got {moments!r}")
+    mdt = jnp.float32 if moments == "f32" else jnp.bfloat16
+
+    def mk_v(p):
+        if moments == "factored" and _factored_leaf(p.shape):
+            return {"r": jnp.zeros(p.shape[:-1], jnp.float32),
+                    "c": jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32)}
+        return jnp.zeros(p.shape, jnp.float32 if moments != "bf16"
+                         else jnp.bfloat16)
+
+    return {"m": jax.tree.map(lambda p: jnp.zeros(p.shape, mdt), params),
+            "v": jax.tree.map(mk_v, params),
             "step": jnp.zeros((), jnp.int32)}
 
 
 def adamw_update(params, grads, state, lr=3e-4, beta1=0.9, beta2=0.999,
-                 eps=1e-8, weight_decay=0.01):
+                 eps=1e-8, weight_decay=0.01, moments="f32"):
     step = state["step"] + 1
     b1t = 1.0 - beta1 ** step.astype(jnp.float32)
     b2t = 1.0 - beta2 ** step.astype(jnp.float32)
+    # all math runs in f32; `moments` only selects the *storage* format
+    # written back each step. Stochastic rounding keys are derived from the
+    # step so the noise sequence is reproducible and state stays a pure
+    # function of (params, grads, step).
+    base_key = (jax.random.key(step.astype(jnp.uint32))
+                if moments != "f32" else None)
 
-    def upd(p, g, m, v):
+    def store(x32, leaf_idx, slot):
+        if moments == "f32":
+            return x32
+        return _stochastic_round_bf16(
+            jax.random.fold_in(base_key, 2 * leaf_idx + slot), x32)
+
+    def upd(i, p, g, m, v):
         g32 = g.astype(jnp.float32)
-        m = beta1 * m + (1 - beta1) * g32
-        v = beta2 * v + (1 - beta2) * (g32 * g32)
-        mhat = m / b1t
-        vhat = v / b2t
+        m32 = beta1 * m.astype(jnp.float32) + (1 - beta1) * g32
+        if isinstance(v, dict):  # factored second moment
+            g2 = g32 * g32
+            r = beta2 * v["r"] + (1 - beta2) * g2.mean(axis=-1)
+            c = beta2 * v["c"] + (1 - beta2) * g2.mean(axis=-2)
+            # v_ij ~= r_i * c_j / mean(r): exact when g^2 is rank-1
+            denom = jnp.maximum(r.mean(axis=-1, keepdims=True), 1e-30)
+            vhat = (r / denom)[..., :, None] * c[..., None, :] / b2t
+            new_v = {"r": r, "c": c}
+        else:
+            v32 = beta2 * v.astype(jnp.float32) + (1 - beta2) * (g32 * g32)
+            vhat = v32 / b2t
+            # factored mode keeps full-f32 v on its rank<2 leaves (tiny);
+            # only the 'bf16' mode rounds the second moment down
+            new_v = store(v32, i, 1) if moments == "bf16" else v32
+        mhat = m32 / b1t
         p32 = p.astype(jnp.float32)
         p32 = p32 - lr * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p32)
-        return p32.astype(p.dtype), m, v
+        return p32.astype(p.dtype), store(m32, i, 0), new_v
 
     flat_p, tdef = jax.tree.flatten(params)
     flat_g = tdef.flatten_up_to(grads)
     flat_m = tdef.flatten_up_to(state["m"])
     flat_v = tdef.flatten_up_to(state["v"])
-    out = [upd(p, g, m, v) for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+    out = [upd(i, p, g, m, v)
+           for i, (p, g, m, v) in enumerate(zip(flat_p, flat_g, flat_m, flat_v))]
     new_p = tdef.unflatten([o[0] for o in out])
     new_m = tdef.unflatten([o[1] for o in out])
     new_v = tdef.unflatten([o[2] for o in out])
@@ -100,7 +156,7 @@ class HybridParallelEngine:
     def __init__(self, config, dp=1, pp=1, mp=1, micro_batches=None, sp=False,
                  devices=None, dtype=jnp.float32, remat=True, lr=3e-4,
                  schedule="gpipe", num_virtual_stages=2, zero_stage=1,
-                 loss_chunk=None):
+                 loss_chunk=None, moments="f32"):
         from paddle_tpu.models.llama import LlamaConfig  # noqa: F401 (type)
 
         self.config = config
@@ -115,6 +171,12 @@ class HybridParallelEngine:
         # f32 logits never materialize at once — vocab matmul + CE run per
         # seq chunk with rematerialization (forward_and_loss loss_chunk)
         self.loss_chunk = loss_chunk
+        # moment storage: 'f32' | 'bf16' (stochastic-rounded) | 'factored'
+        # (Adafactor-style second moment). On a 16G chip the f32 moments of
+        # a ~1B model (7.5GB) are what force remat in the first place.
+        if moments not in ("f32", "bf16", "factored"):
+            raise ValueError("moments must be 'f32', 'bf16' or 'factored'")
+        self.moments = moments
         # ZeRO: stage 1/2 = dp-sharded AdamW moments (in ONE compiled step
         # the stage-1/2 distinction collapses — XLA frees grads inside the
         # program); stage 3 additionally shards the LAYER params over 'dp':
@@ -266,11 +328,21 @@ class HybridParallelEngine:
         self._param_shardings = jax.tree.map(
             self._sharding, self._param_specs, is_leaf=lambda x: isinstance(x, P))
         specs_tree = self._spec_tree(shapes)
+
+        def v_shard(sp, sh):
+            if self.moments == "factored" and _factored_leaf(sh.shape):
+                # r/c inherit the param's sharding minus the factored axis
+                # (keeps e.g. the stacked-layer 'pp' axis sharded); they're
+                # tiny either way
+                parts = list(sp) + [None] * (len(sh.shape) - len(sp))
+                return {"r": self._sharding(P(*parts[:-1])),
+                        "c": self._sharding(P(*(parts[:-2] + parts[-1:])))}
+            return self._sharding(self._zero_spec(sp, sh.shape))
+
         self._opt_shardings = {
             "m": jax.tree.map(lambda sp, sh: self._sharding(
                 self._zero_spec(sp, sh.shape)), specs_tree, shapes),
-            "v": jax.tree.map(lambda sp, sh: self._sharding(
-                self._zero_spec(sp, sh.shape)), specs_tree, shapes),
+            "v": jax.tree.map(v_shard, specs_tree, shapes),
             "step": self._sharding(P()),
         }
 
@@ -304,7 +376,8 @@ class HybridParallelEngine:
             make = lambda k: lf.init_params(args, k, dtype)  # noqa: E731
         init_fn = jax.jit(make, out_shardings=self._param_shardings)
         params = init_fn(key)
-        opt_init = jax.jit(adamw_init, out_shardings=self._opt_shardings)
+        opt_init = jax.jit(functools.partial(adamw_init, moments=self.moments),
+                           out_shardings=self._opt_shardings)
         opt_state = opt_init(params)
         return params, opt_state
 
@@ -912,11 +985,12 @@ class HybridParallelEngine:
                 out_specs=(P(), flat_specs_tree),
                 check_vma=True)
 
-        lr = self.lr
+        lr, moments = self.lr, self.moments
 
         def train_step(params, opt_state, ids, labels):
             loss, grads = shard_mapped(params, ids, labels)
-            new_params, new_opt = adamw_update(params, grads, opt_state, lr=lr)
+            new_params, new_opt = adamw_update(params, grads, opt_state,
+                                               lr=lr, moments=moments)
             return loss, new_params, new_opt
 
         self._ensure_shardings()

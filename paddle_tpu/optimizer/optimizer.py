@@ -244,12 +244,20 @@ class RMSProp(Optimizer):
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, use_multi_tensor=False, name=None):
+                 multi_precision=False, use_multi_tensor=False, name=None,
+                 moment_dtype="float32"):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._multi_precision = multi_precision
+        # memory-lean moment storage: 'bfloat16' halves optimizer-state HBM
+        # (stochastic-rounding write-back keeps the EMA unbiased; math stays
+        # f32). The compiled engine exposes the same knob as
+        # HybridParallelEngine(moments=...).
+        if moment_dtype not in ("float32", "bfloat16"):
+            raise ValueError("moment_dtype must be 'float32' or 'bfloat16'")
+        self._moment_dtype = jnp.dtype(moment_dtype)
 
     def _decay(self, p, g):
         if self._weight_decay:
@@ -262,13 +270,24 @@ class Adam(Optimizer):
 
     def _adam_update(self, p, g, lr):
         g32 = g.astype(jnp.float32)
-        m = self._acc("moment1", p, jnp.zeros_like(p._data, jnp.float32))
-        v = self._acc("moment2", p, jnp.zeros_like(p._data, jnp.float32))
+        mdt = self._moment_dtype
+        m = self._acc("moment1", p, jnp.zeros_like(p._data, mdt))
+        v = self._acc("moment2", p, jnp.zeros_like(p._data, mdt))
         t = self._step_count
-        m = self._beta1 * m + (1 - self._beta1) * g32
-        v = self._beta2 * v + (1 - self._beta2) * g32 * g32
-        self._set_acc("moment1", p, m)
-        self._set_acc("moment2", p, v)
+        m = self._beta1 * m.astype(jnp.float32) + (1 - self._beta1) * g32
+        v = self._beta2 * v.astype(jnp.float32) + (1 - self._beta2) * g32 * g32
+        if mdt == jnp.bfloat16:
+            import jax
+
+            from paddle_tpu.core.numerics import stochastic_round_bf16
+            key = jax.random.fold_in(jax.random.key(t), id(p) & 0x7FFFFFFF)
+            self._set_acc("moment1", p, stochastic_round_bf16(
+                jax.random.fold_in(key, 0), m))
+            self._set_acc("moment2", p, stochastic_round_bf16(
+                jax.random.fold_in(key, 1), v))
+        else:
+            self._set_acc("moment1", p, m)
+            self._set_acc("moment2", p, v)
         mhat = m / (1 - self._beta1 ** t)
         vhat = v / (1 - self._beta2 ** t)
         master = self._acc("master", p, p._data.astype(jnp.float32)) if self._multi_precision else p._data.astype(jnp.float32)
@@ -283,9 +302,11 @@ class AdamW(Adam):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
-                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None,
+                 moment_dtype="float32"):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters, None,
-                         grad_clip, lazy_mode, multi_precision, name)
+                         grad_clip, lazy_mode, multi_precision, name=name,
+                         moment_dtype=moment_dtype)
         self._wd = weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio

@@ -609,3 +609,106 @@ def test_trivial_fast_path_loss_chunk_parity():
     p2, o2 = e2.init_state(0)
     l2, _, _ = e2.train_batch(p2, o2, ids, labels)
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+
+
+# -- memory-lean optimizer-state modes (moments='bf16'/'factored') -----------
+
+
+def test_stochastic_round_bf16_unbiased():
+    """E[SR(x)] == x: the property that lets a bf16 EMA accumulate
+    increments below its own ulp (plain rounding would drop them)."""
+    from paddle_tpu.distributed.hybrid_engine import _stochastic_round_bf16
+
+    x = jnp.full((20000,), 1.001953125, jnp.float32)  # halfway+eps cases
+    key = jax.random.key(0)
+    r = _stochastic_round_bf16(key, x).astype(jnp.float32)
+    # each sample is one of the two neighbouring bf16 values
+    assert set(np.unique(np.asarray(r))).issubset({1.0, 1.0078125})
+    np.testing.assert_allclose(float(r.mean()), 1.001953125, rtol=2e-3)
+    # non-finite passes through
+    bad = jnp.asarray([np.inf, -np.inf, np.nan], jnp.float32)
+    rb = np.asarray(_stochastic_round_bf16(key, bad).astype(jnp.float32))
+    assert np.isposinf(rb[0]) and np.isneginf(rb[1]) and np.isnan(rb[2])
+
+
+@pytest.mark.parametrize("moments", ["f32", "bf16", "factored"])
+def test_moments_state_stable_across_steps(moments):
+    """Opt-state dtypes/structure after an update equal the init state's —
+    no silent f32 promotion (pre-r5 the bf16-param engine retraced at step 2
+    because the update returned f32 moments for a bf16-init state)."""
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=1, pp=1, mp=1, dtype=jnp.bfloat16,
+                               moments=moments)
+    params, opt = eng.init_state(0)
+    ids, labels = _batch()
+    _, params, opt2 = eng.train_batch(params, opt, ids, labels)
+    init_ref = jax.tree.map(lambda a: (a.shape, str(a.dtype)),
+                            eng.init_state(0)[1])
+    got = jax.tree.map(lambda a: (a.shape, str(a.dtype)), opt2)
+    assert init_ref == got
+    if moments == "factored":
+        flat = jax.tree_util.tree_leaves_with_path(opt2["v"])
+        assert any("'r'" in jax.tree_util.keystr(p) for p, _ in flat)
+
+
+def test_factored_moments_memory_is_lean():
+    """factored mode's second-moment state is <2% of the f32 one."""
+    from paddle_tpu.distributed.hybrid_engine import adamw_init
+
+    cfg = _tiny_cfg()
+    args = lf.LlamaArgs.from_config(cfg)
+    shapes = jax.eval_shape(
+        lambda k: lf.init_params(args, k, jnp.bfloat16), jax.random.key(0))
+
+    def nbytes(tree):
+        return sum(np.prod(a.shape) * a.dtype.itemsize
+                   for a in jax.tree.leaves(jax.eval_shape(
+                       lambda: adamw_init(shapes, moments=tree))["v"]))
+
+    # <5% on the tiny model (rank-1 leaves dominate at toy scale; on the
+    # 0.94B bench model the ratio is ~0.1%)
+    assert nbytes("factored") < 0.05 * nbytes("f32")
+
+
+@pytest.mark.parametrize("moments", ["bf16", "factored"])
+def test_lean_moments_convergence_parity(moments):
+    """30 steps on the tiny model: lean moment storage tracks the f32
+    loss curve (the done-criterion for swapping it into the bench)."""
+    cfg = _tiny_cfg()
+    ids, labels = _batch(B=8, s=32)
+
+    def run(mode):
+        eng = HybridParallelEngine(cfg, dp=1, pp=1, mp=1, lr=3e-3,
+                                   moments=mode)
+        params, opt = eng.init_state(0)
+        losses = []
+        for _ in range(30):
+            loss, params, opt = eng.train_batch(params, opt, ids, labels)
+            losses.append(float(loss))
+        return losses
+
+    ref = run("f32")
+    got = run(moments)
+    assert got[-1] < ref[0] * 0.7, "lean-moment run failed to descend"
+    if moments == "bf16":
+        # stochastic rounding is unbiased: same optimizer trajectory
+        assert abs(got[-1] - ref[-1]) / ref[-1] < 0.03, (ref[-1], got[-1])
+    else:
+        # factored v is a different (Adafactor-style) estimator — require a
+        # healthy trajectory in the same ballpark, not bit-parity (measured:
+        # it descends *faster* on this model, 0.38 vs 0.55 at step 30)
+        assert abs(np.log(got[-1] / ref[-1])) < 0.6, (ref[-1], got[-1])
+
+
+@pytest.mark.parametrize("moments", ["bf16", "factored"])
+def test_lean_moments_on_hybrid_mesh(moments):
+    """Lean moments compose with the sharded dp*pp*mp path + ZeRO moment
+    sharding (factored r/c inherit the param spec minus the factored axis)."""
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=2, pp=2, mp=2, micro_batches=2,
+                               moments=moments, zero_stage=1)
+    params, opt = eng.init_state(0)
+    ids, labels = _batch()
+    loss, params, opt = eng.train_batch(params, opt, ids, labels)
+    loss2, _, _ = eng.train_batch(params, opt, ids, labels)
+    assert float(loss2) < float(loss)

@@ -108,3 +108,36 @@ def test_optimizer_trains_linear_model():
         opt.step()
         opt.clear_grad()
     assert float(loss) < 1e-2
+
+
+def test_adamw_bf16_moment_dtype():
+    """moment_dtype='bfloat16' halves moment storage and tracks the f32
+    trajectory (stochastic-rounding write-back; engine analogue is
+    HybridParallelEngine(moments='bf16'))."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    def run(moment_dtype):
+        paddle.seed(7)
+        layer = nn.Linear(16, 16)
+        opt = paddle.optimizer.AdamW(learning_rate=0.05,
+                                     parameters=layer.parameters(),
+                                     moment_dtype=moment_dtype)
+        x = paddle.ones([4, 16])
+        losses = []
+        for _ in range(20):
+            loss = (layer(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses, opt
+
+    ref, _ = run("float32")
+    got, opt = run("bfloat16")
+    assert all(v.dtype == jnp.bfloat16 for k, v in opt._accumulators.items()
+               if k[0].startswith("moment"))
+    assert got[-1] < ref[0] * 0.5
+    assert abs(got[-1] - ref[-1]) <= max(0.05 * abs(ref[-1]), 5e-4), (ref[-1], got[-1])

@@ -22,23 +22,33 @@ H2048 = dict(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
              num_hidden_layers=16, num_attention_heads=16,
              max_position_embeddings=2048)
 
-# measured on TPU v5e-16G (2026-07): full remat b8 ~17.0k tok/s;
-# remat='half' OOMs at every batch (the f32 AdamW moments leave no room);
-# 'dots' + chunked CE + 2 accumulated micro-batches wins at ~17.5k.
+# r4 measured on TPU v5e-16G (2026-07): full remat b8 ~17.0k tok/s;
+# remat='half' OOMed at every batch (the then-f32 AdamW moments, 7.5GB,
+# left no room); 'dots' + chunked CE + 2 accumulated micro-batches won at
+# ~17.5k. r5: moments='bf16' (stochastic-rounded) frees 3.8GB and
+# 'factored' ~7.3GB — sweep 'half' and no-remat at the freed budget.
 SPECS = [
-    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": True},
-    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": True,
-     "loss_chunk": 128},
-    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "half",
-     "loss_chunk": 128},
-    {"cfg": H2048, "batch": 4, "seq": 1024, "remat": "dots",
-     "loss_chunk": 128},
+    # r4 champion re-run (comparison point)
     {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "dots",
      "loss_chunk": 128, "micro_batches": 2},
+    # lean moments + half remat: the predicted r5 winner
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "half",
+     "loss_chunk": 128, "moments": "bf16"},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "half",
+     "loss_chunk": 128, "moments": "factored"},
+    # lean moments + dots (r4 champion's remat, smaller opt state)
     {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "dots",
-     "loss_chunk": 256, "micro_batches": 2},
-    {"cfg": H2048, "batch": 16, "seq": 1024, "remat": True,
-     "loss_chunk": 128},
+     "loss_chunk": 128, "micro_batches": 2, "moments": "bf16"},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "dots",
+     "loss_chunk": 128, "moments": "bf16"},
+    # no remat at all — fits only if activations squeeze into ~10GB
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": False,
+     "loss_chunk": 128, "moments": "factored"},
+    {"cfg": H2048, "batch": 4, "seq": 1024, "remat": False,
+     "loss_chunk": 128, "moments": "bf16"},
+    # bigger batch under lean moments
+    {"cfg": H2048, "batch": 16, "seq": 1024, "remat": "half",
+     "loss_chunk": 128, "moments": "bf16"},
 ]
 
 

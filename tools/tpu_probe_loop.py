@@ -1,0 +1,49 @@
+"""Probe the axon TPU tunnel once, with an internal watchdog.
+
+One attempt = one subprocess that self-watchdogs with SIGALRM (never killed
+externally: an external kill mid-claim is what wedges the tunnel claim in
+the first place, and the harness kills background shells at 10 min, so the
+child's own alarm must always fire first). Status appended to
+/tmp/tpu_probe_status. Exits 0 on a successful device matmul; re-run
+between work chunks until it does.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+STATUS = "/tmp/tpu_probe_status"
+ALARM_S = 480
+
+ATTEMPT = r"""
+import os, signal, time
+def _bail(s, f):
+    print("TIMEOUT", flush=True); os._exit(3)
+signal.signal(signal.SIGALRM, _bail)
+signal.alarm(%d)
+t0 = time.time()
+import jax
+ds = jax.devices()
+import jax.numpy as jnp
+y = float((jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum())
+signal.alarm(0)
+print(f"OK backend={jax.default_backend()} kind={ds[0].device_kind} "
+      f"matmul={y} init_s={time.time()-t0:.1f}", flush=True)
+""" % ALARM_S
+
+
+def main():
+    t = time.strftime("%H:%M:%S")
+    r = subprocess.run([sys.executable, "-u", "-c", ATTEMPT],
+                       capture_output=True, text=True,
+                       cwd=os.path.dirname(os.path.dirname(
+                           os.path.abspath(__file__))))
+    line = (r.stdout.strip().splitlines() or ["no-output"])[-1]
+    with open(STATUS, "a") as f:
+        f.write(f"{t} rc={r.returncode} {line}\n")
+    return 0 if (r.returncode == 0 and line.startswith("OK")) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
