@@ -138,6 +138,17 @@ def _candidate_configs(backend):
 
 
 def _run_single(spec_json):
+    # self-watchdog: exit before any parent subprocess timeout can kill us
+    # mid-claim (an external kill while holding the tunnel claim is what
+    # wedged round 4 for 5+ hours)
+    import signal
+
+    def _stuck(signum, frame):
+        print("BENCH_SINGLE_TIMEOUT", flush=True)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, _stuck)
+    signal.alarm(780)
     spec = json.loads(spec_json)
     tps, fpt, n = _bench(spec["cfg"], spec["batch"], spec["seq"],
                          spec.get("remat", True),

@@ -21,15 +21,24 @@ from paddle_tpu.jit.dy2static import Dy2StaticFallback
 from paddle_tpu.nn.layer.layers import Layer
 
 __all__ = ["to_static", "functionalize", "save", "load", "not_to_static",
-           "TracedLayer", "fallback_count"]
+           "TracedLayer", "fallback_count", "fallback_report"]
 
 _fallback_count = 0
+_fallback_records = []
 
 
 def fallback_count():
-    """Number of to_static callables that degraded to eager this process
-    (test hook: dy2static-converted models must keep this at zero)."""
+    """Number of to_static callables that degraded WHOLLY to eager this
+    process (test hook: dy2static-converted models must keep this at zero).
+    Per-region fallbacks do NOT count — the callable stays compiled."""
     return _fallback_count
+
+
+def fallback_report():
+    """What fell back, per callable (the reference SOT's breakgraph
+    counters, `jit/sot/utils/info_collector.py` analogue): a list of
+    {"name", "event": "region"|"eager", "detail"} records in order."""
+    return list(_fallback_records)
 
 
 class _SwappedState:
@@ -72,6 +81,16 @@ def _tree_to_tensor(x):
     return jax.tree.map(lambda a: Tensor(a) if isinstance(a, jax.Array) else a, x)
 
 
+class _DynSlot:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<dyn>"
+
+
+_DYN = _DynSlot()  # placeholder for an array leaf in a static skeleton
+
+
 def functionalize(layer, forward=None):
     """Return (pure_fn, params, buffers):
     pure_fn(params, buffers, key, *args, **kwargs) -> (outputs, new_buffers).
@@ -112,19 +131,39 @@ class StaticFunction:
     def __init__(self, function, input_spec=None, build_strategy=None, backend=None):
         self._fn = function
         self._layer = function if isinstance(function, Layer) else None
-        self._jitted = None
+        self._jitted = None   # build marker; compiled fns live in _jit_cache
+        self._jit_cache = {}  # static-arg skeleton -> jitted wrapper
         self._state = None
         self._eager_only = False
+        # per-region fallback blacklist: (namespace, uid) regions left as
+        # Python on re-conversion (reference SOT falls back per sub-graph,
+        # `jit/sot/translate.py:37`; ours is per AST region)
+        self._skip_regions = set()
+        self._converted = None
 
     def _build(self):
+        from paddle_tpu.jit import dy2static as _d2s
+
+        tok = _d2s._ACTIVE_SKIP.set(frozenset(self._skip_regions))
+        try:
+            self._build_inner()
+        finally:
+            _d2s._ACTIVE_SKIP.reset(tok)
+
+    def _build_inner(self):
+        from paddle_tpu.jit import dy2static as _d2s
+
+        self._jit_cache = {}
         if self._layer is not None:
+            # grab the converted forward's report handle (cache hit inside
+            # functionalize's converted_layer_call)
+            self._converted = _d2s.convert_function(self._layer.forward)
             pure_fn, params, buffers = functionalize(self._layer)
             self._pure_fn = pure_fn
-            self._jitted = jax.jit(pure_fn)
+            self._jitted = True
         else:
-            from paddle_tpu.jit import dy2static as _d2s
-
             fn = _d2s.convert_function(self._fn)
+            self._converted = fn
 
             def pure_fn(key, *args, **kwargs):
                 _rng.push_trace_key(key)
@@ -139,53 +178,150 @@ class StaticFunction:
                 finally:
                     _rng.pop_trace_key()
 
-            self._jitted = jax.jit(pure_fn)
+            self._jitted = True
             self._pure_fn = pure_fn
 
+    _MAX_REGION_RETRIES = 8
+
+    def _split_static(self, args, kwargs):
+        """Split (args, kwargs) into dynamic array leaves and a STATIC
+        skeleton. Non-array Python leaves (bools, ints, strs, None, ...)
+        are compile-time constants — the reference's dy2static bakes
+        non-tensor arguments into the program the same way — so a concrete
+        `if flag:` stays concrete inside the trace instead of becoming a
+        traced scalar that lax.cond would trace both ways."""
+        import numpy as np
+
+        leaves, treedef = jax.tree.flatten(
+            (args, kwargs), is_leaf=lambda t: isinstance(t, Tensor))
+        dyn, skel = [], []
+        for leaf in leaves:
+            if isinstance(leaf, Tensor):
+                dyn.append(leaf._data)
+                skel.append(_DYN)
+            elif isinstance(leaf, (jax.Array, np.ndarray)):
+                dyn.append(jnp.asarray(leaf))
+                skel.append(_DYN)
+            else:
+                skel.append(leaf)
+
+        def hashable(s):
+            try:
+                hash(s)
+                return s
+            except TypeError:
+                return ("__unhashable__", id(s))
+
+        skey = (treedef, tuple(hashable(s) for s in skel))
+        return dyn, skel, treedef, skey
+
+    def _jit_for(self, skel, treedef, skey):
+        jitted = self._jit_cache.get(skey)
+        if jitted is not None:
+            return jitted
+        pure_fn = self._pure_fn
+        skel = list(skel)
+        layer_mode = self._layer is not None
+
+        def rebuild(dyn):
+            it = iter(dyn)
+            leaves = [next(it) if s is _DYN else s for s in skel]
+            return jax.tree.unflatten(treedef, leaves)
+
+        if layer_mode:
+            def wrapper(params, buffers, key, dyn):
+                a, kw = rebuild(dyn)
+                return pure_fn(params, buffers, key, *a, **kw)
+        else:
+            def wrapper(key, dyn):
+                a, kw = rebuild(dyn)
+                return pure_fn(key, *a, **kw)
+
+        jitted = jax.jit(wrapper)
+        self._jit_cache[skey] = jitted
+        return jitted
+
+    def _run_once(self, args, kwargs):
+        key = _rng.next_key()
+        dyn, skel, treedef, skey = self._split_static(args, kwargs)
+        jitted = self._jit_for(skel, treedef, skey)
+        if self._layer is not None:
+            state = _SwappedState(self._layer)
+            params = {k: p._data for k, p in state.params.items()}
+            buffers = {k: b._data for k, b in state.buffers.items()}
+            out, new_buffers = jitted(params, buffers, key, dyn)
+            for k, b in state.buffers.items():
+                b._data = new_buffers[k]
+            return _tree_to_tensor(out)
+        out = jitted(key, dyn)
+        return _tree_to_tensor(out)
+
+    def _name(self):
+        return getattr(self._fn, "__name__", type(self._fn).__name__)
+
     def __call__(self, *args, **kwargs):
+        import warnings
+
+        from paddle_tpu.jit import dy2static as _d2s
+
         if self._eager_only:
             return self._fn(*args, **kwargs)
-        if self._jitted is None:
+        for _ in range(self._MAX_REGION_RETRIES + 1):
+            if self._jitted is None:
+                self._build()
+            tok = _d2s._ACTIVE_SKIP.set(frozenset(self._skip_regions))
+            try:
+                return self._run_once(args, kwargs)
+            except Dy2StaticFallback as e:
+                region = getattr(e, "region", None)
+                if region is not None and region not in self._skip_regions:
+                    # PER-REGION fallback: re-convert with just this region
+                    # left as Python and retry — if its predicates are
+                    # concrete the callable STAYS compiled, minus one region
+                    self._skip_regions.add(region)
+                    self._jitted = None
+                    _fallback_records.append(
+                        {"name": self._name(), "event": "region",
+                         "detail": f"{region[0]}#r{region[1]}: {e}"})
+                    warnings.warn(
+                        f"to_static({self._name()}): region "
+                        f"{region[0]}#r{region[1]} is not compilable "
+                        f"({e}); retrying with it as ordinary Python.")
+                    continue
+                break  # regionless or already-skipped: whole-callable eager
+            except (jax.errors.TracerBoolConversionError,
+                    jax.errors.ConcretizationTypeError,
+                    jax.errors.TracerIntegerConversionError,
+                    jax.errors.TracerArrayConversionError):
+                break
+            finally:
+                _d2s._ACTIVE_SKIP.reset(tok)
+        # tensor-dependent Python control flow the dy2static converter
+        # couldn't capture and region retries couldn't isolate; degrade the
+        # WHOLE callable to eager with a warning instead of crashing
+        global _fallback_count
+        _fallback_count += 1
+        _fallback_records.append({"name": self._name(), "event": "eager",
+                                  "detail": "whole callable degraded"})
+        # per-callable warning: EVERY degraded function must announce
+        # itself (a global once-flag would silence later fallbacks)
+        warnings.warn(
+            f"to_static({self._name()}): tensor-dependent Python control "
+            "flow cannot be traced; this callable now runs eagerly. Rewrite "
+            "with paddle.where / lax-style control flow to compile.")
+        self._eager_only = True
+        return self._fn(*args, **kwargs)
+
+    def conversion_report(self):
+        """Per-region conversion outcome of the top callable (+ the active
+        per-region fallback set). Reference analogue: SOT's info collector /
+        breakgraph reason dump."""
+        if self._jitted is None and not self._eager_only:
             self._build()
-        key = _rng.next_key()
-        arg_datas = _tree_to_data(args)
-        kwarg_datas = _tree_to_data(kwargs)
-        try:
-            if self._layer is not None:
-                state = _SwappedState(self._layer)
-                params = {k: p._data for k, p in state.params.items()}
-                buffers = {k: b._data for k, b in state.buffers.items()}
-                out, new_buffers = self._jitted(params, buffers, key,
-                                                *arg_datas, **kwarg_datas)
-                for k, b in state.buffers.items():
-                    b._data = new_buffers[k]
-                return _tree_to_tensor(out)
-            out = self._jitted(key, *arg_datas, **kwarg_datas)
-            return _tree_to_tensor(out)
-        except (jax.errors.TracerBoolConversionError,
-                jax.errors.ConcretizationTypeError,
-                jax.errors.TracerIntegerConversionError,
-                jax.errors.TracerArrayConversionError,
-                Dy2StaticFallback):
-            # tensor-dependent Python control flow the dy2static converter
-            # couldn't capture (the reference's SOT falls back to eager
-            # sub-graphs here, jit/sot/translate.py); degrade the WHOLE
-            # callable to eager with a warning instead of crashing user code
-            import warnings
-
-            global _fallback_count
-            _fallback_count += 1
-
-            name = getattr(self._fn, "__name__",
-                           type(self._fn).__name__)
-            # per-callable warning: EVERY degraded function must announce
-            # itself (a global once-flag would silence later fallbacks)
-            warnings.warn(
-                f"to_static({name}): tensor-dependent Python control flow "
-                "cannot be traced; this callable now runs eagerly. Rewrite "
-                "with paddle.where / lax-style control flow to compile.")
-            self._eager_only = True
-            return self._fn(*args, **kwargs)
+        rep = getattr(self._converted, "__pt_dy2static_report__", None)
+        return {"report": rep,
+                "fallback_regions": sorted(self._skip_regions),
+                "eager_only": self._eager_only}
 
     # reference-compat introspection
     @property

@@ -44,7 +44,8 @@ from paddle_tpu.core.tensor import Tensor
 __all__ = [
     "convert_function", "converted_layer_call", "convert_ifelse",
     "convert_while", "convert_for_range", "convert_logical_and",
-    "convert_logical_or", "convert_logical_not", "Dy2StaticFallback",
+    "convert_logical_or", "convert_logical_not", "convert_call",
+    "Dy2StaticFallback",
 ]
 
 _RUNTIME_NAME = "__pt_jst__"
@@ -52,8 +53,15 @@ _RUNTIME_NAME = "__pt_jst__"
 
 class Dy2StaticFallback(Exception):
     """Raised by the converted-op runtime when a construct turns out to be
-    uncompilable at trace time (e.g. branch pytrees mismatch); the
-    StaticFunction catches it and degrades the callable to eager."""
+    uncompilable at trace time (e.g. branch pytrees mismatch). Carries the
+    failing REGION — (function qualname, region id) — so StaticFunction can
+    re-convert with just that region left as Python and retry, instead of
+    degrading the whole callable to eager (the reference SOT's sub-graph
+    fallback, `jit/sot/translate.py:37`, done at AST granularity)."""
+
+    def __init__(self, msg, region=None):
+        super().__init__(msg)
+        self.region = region
 
 
 # --------------------------------------------------------------------------
@@ -97,7 +105,21 @@ def _to_tensor_tree(x):
         lambda v: Tensor(v) if isinstance(v, jax.Array) else v, x)
 
 
-def convert_ifelse(pred, true_fn, false_fn, init=()):
+def _tag_region(region):
+    """Decorator: Dy2StaticFallback escaping the converted op gets stamped
+    with the op's region (innermost region wins — nested converted ops
+    re-raise with their own region already set)."""
+    def deco(call):
+        try:
+            return call()
+        except Dy2StaticFallback as e:
+            if e.region is None:
+                e.region = region
+            raise
+    return deco
+
+
+def convert_ifelse(pred, true_fn, false_fn, init=(), region=None):
     """`if pred: <assigns>` -> the tuple of branch-assigned variables.
     `init` carries the variables' pre-branch values in as branch-function
     parameters (a name assigned inside a branch is local to the generated
@@ -108,6 +130,11 @@ def convert_ifelse(pred, true_fn, false_fn, init=()):
     if not _is_traced(pred):
         taken = true_fn if _truthy(pred) else false_fn
         return taken(*init)
+    return _tag_region(region)(lambda: _convert_ifelse_traced(
+        pred, true_fn, false_fn, init))
+
+
+def _convert_ifelse_traced(pred, true_fn, false_fn, init):
     p = _pred_scalar(pred)
     try:
         out = jax.lax.cond(
@@ -123,7 +150,7 @@ def convert_ifelse(pred, true_fn, false_fn, init=()):
     return _to_tensor_tree(out)
 
 
-def convert_while(cond_fn, body_fn, init):
+def convert_while(cond_fn, body_fn, init, region=None):
     """`while cond: <body>` over the body-assigned loop variables.
     Traced condition: `lax.while_loop` with the variables as carry (they
     are fixed to their traced shapes/dtypes). Concrete: Python loop."""
@@ -137,6 +164,11 @@ def convert_while(cond_fn, body_fn, init):
             c = cond_fn(*state)
         return state
 
+    return _tag_region(region)(
+        lambda: _convert_while_traced(cond_fn, body_fn, init))
+
+
+def _convert_while_traced(cond_fn, body_fn, init):
     arr_init = _to_array_tree(tuple(init), "the loop state")
 
     def c_fn(s):
@@ -247,11 +279,16 @@ def range_next(i, r):
 _UNROLL_LIMIT = 64
 
 
-def convert_for_range(cond_fn, body_fn, init, r):
+def convert_for_range(cond_fn, body_fn, init, r, region=None,
+                      has_guard=False):
     """Converted `for target in range(...)`. init = (counter, target,
     *loop_vars); counter rides the carry, target is assigned from it at
     the top of each body (so after the loop it holds Python's LAST body
-    value, and a zero-trip loop leaves it untouched/unbound)."""
+    value, and a zero-trip loop leaves it untouched/unbound).
+
+    has_guard: the body came from break/continue desugaring — the loop
+    condition carries a break-guard that must be re-checked between
+    iterations, so the fixed-trip-count unroll path is invalid."""
     def lax_init():
         # the carry needs a concrete leaf for the target; the body assigns
         # it from the counter before any use (only the data-dependent
@@ -261,8 +298,14 @@ def convert_for_range(cond_fn, body_fn, init, r):
             st[1] = r.start
         return tuple(st)
 
+    if has_guard:
+        first = cond_fn(*init)
+        if not _is_traced(first) and not any(
+                _is_traced(v) for v in jax.tree.leaves(tuple(init))):
+            return convert_while(cond_fn, body_fn, init, region=region)
+        return convert_while(cond_fn, body_fn, lax_init(), region=region)
     if _is_traced(r.stop) or _is_traced(r.start):
-        return convert_while(cond_fn, body_fn, lax_init())
+        return convert_while(cond_fn, body_fn, lax_init(), region=region)
     n = len(range(int(operator.index(r.start)),
                   int(operator.index(r.stop)), r.step))
     if n <= _UNROLL_LIMIT:
@@ -270,7 +313,7 @@ def convert_for_range(cond_fn, body_fn, init, r):
         for _ in range(n):
             state = tuple(body_fn(*state))
         return state
-    return convert_while(cond_fn, body_fn, lax_init())
+    return convert_while(cond_fn, body_fn, lax_init(), region=region)
 
 
 def _truthy(x):
@@ -306,6 +349,34 @@ def convert_logical_not(x):
     if _is_traced(x):
         return Tensor(jnp.logical_not(jnp.asarray(_unwrap(x)).astype(bool)))
     return not x
+
+
+def convert_call(fn):
+    """Call-site conversion of callees (reference `convert_operators.py`
+    convert_call + `convert_call_func.py`): user functions and sublayers
+    reached from a converted function get converted too, so tensor-dependent
+    control flow in a helper compiles instead of degrading the whole model —
+    and a helper that CAN'T convert stays ordinary Python, losing only
+    itself. Framework/library callables pass through untouched (paddle_tpu
+    internals are trace-safe by construction; jax/numpy likewise)."""
+    from paddle_tpu.nn.layer.layers import Layer
+
+    def library_mod(m):
+        # exact top-level package match: a user module named e.g.
+        # `jax_utils` must NOT be exempted by a bare prefix test
+        return (m.split(".", 1)[0]
+                in ("paddle_tpu", "jax", "jaxlib", "numpy", "functools"))
+
+    if isinstance(fn, Layer):
+        fwd = getattr(type(fn), "forward", None)
+        if library_mod(getattr(fwd, "__module__", "") or ""):
+            return fn  # builtin layer: forward is trace-safe already
+        return converted_layer_call(fn)
+    if not isinstance(fn, (types.FunctionType, types.MethodType)):
+        return fn  # builtins, classes, callables without source
+    if library_mod(getattr(fn, "__module__", "") or ""):
+        return fn
+    return convert_function(fn)
 
 
 # --------------------------------------------------------------------------
@@ -391,8 +462,13 @@ class _CtlFlowFinder(ast.NodeVisitor):
 
     def __init__(self):
         self.has_return = False
-        self.has_break_continue = False
+        self.has_break = False
+        self.has_continue = False
         self.has_raise = False
+
+    @property
+    def has_break_continue(self):
+        return self.has_break or self.has_continue
 
     def visit_Return(self, node):
         self.has_return = True
@@ -404,10 +480,10 @@ class _CtlFlowFinder(ast.NodeVisitor):
         self.has_raise = True
 
     def visit_Break(self, node):
-        self.has_break_continue = True
+        self.has_break = True
 
     def visit_Continue(self, node):
-        self.has_break_continue = True
+        self.has_continue = True
 
     def visit_For(self, node):
         # break/continue inside a nested loop bind to it — only returns leak
@@ -500,17 +576,61 @@ def _names_tuple(names, ctx):
     return ast.Tuple(elts=[_name(n, ctx) for n in names], ctx=ctx)
 
 
-class ControlFlowTransformer(ast.NodeTransformer):
-    """Rewrites if/while/bool-ops into converted-op runtime calls."""
+def _ends_in_return(stmts):
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
 
-    def __init__(self):
+
+def _assign_const(name, val):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=val))
+
+
+def _tail_return_body(stmts):
+    """Branch statements ending in Return, with a bare `return` normalized
+    to `return None` (lax.cond branches must produce a value)."""
+    ret = stmts[-1]
+    val = ret.value if ret.value is not None else ast.Constant(value=None)
+    return stmts[:-1] + [ast.Return(value=val)]
+
+
+# builtins called so often that wrapping them in convert_call (a no-op for
+# non-user callables) would only add trace-time overhead
+_DIRECT_CALLS = frozenset({
+    "locals", "globals", "super", "range", "len", "print", "isinstance",
+    "issubclass", "enumerate", "zip", "int", "float", "bool", "str", "list",
+    "tuple", "dict", "set", "frozenset", "min", "max", "abs", "sum",
+    "getattr", "setattr", "hasattr", "type", "id", "repr", "sorted",
+    "reversed", "map", "filter", "any", "all", "divmod", "round", "iter",
+    "next", "vars", "format", "slice",
+})
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites if/while/bool-ops into converted-op runtime calls.
+
+    skip_uids: region ids to leave as ordinary Python (per-region fallback:
+    StaticFunction re-converts with the trace-time-failing region skipped).
+    Region ids are allocated at the ENTRY of every if/while/for visit, so
+    they are stable across re-conversions with different skip sets.
+    """
+
+    def __init__(self, skip_uids=frozenset(), qualname="<fn>", report=None):
         self._n = 0
         self._range_shadowed = False
+        self._skip = frozenset(skip_uids)
+        self._qual = qualname
+        self.report = report if report is not None else []
         # live-after stack: the set of names possibly READ after the
         # statement currently being converted (branch/loop carries are
         # restricted to live names — a dead assigned name must not force
         # both lax.cond branches to produce it)
         self._live = [set()]
+        # per-statement function-tail flags (a fold may append an implicit
+        # `return None` ONLY where falling off the block ends the function)
+        self._stmt_tail = []
+        # desugar-synthesized guard numbering (see _desugar_loop_body)
+        self._synth_loop = None
+        self._synth_seq = 0
 
     def _uid(self):
         self._n += 1
@@ -519,36 +639,71 @@ class ControlFlowTransformer(ast.NodeTransformer):
     def _live_after(self):
         return self._live[-1]
 
-    # -- statement-list processing with `if c: return x` folding ------------
-    def _process_block(self, stmts):
+    def _note(self, kind, line, uid, status, reason=None):
+        self.report.append({"kind": kind, "line": line, "region": uid,
+                            "status": status, "reason": reason})
+
+    def _region_kw(self, uid):
+        return ast.keyword(arg="region", value=ast.Tuple(
+            elts=[ast.Constant(value=self._qual), ast.Constant(value=uid)],
+            ctx=ast.Load()))
+
+    # -- statement-list processing with return folding -----------------------
+    def _process_block(self, stmts, tail=False):
         outer_live = set(self._live[-1])
         # tails[i] = names read by statements AFTER i (plus the block's own
         # live-after set)
         tails = [None] * len(stmts)
-        tail = set(outer_live)
+        live_tail = set(outer_live)
         for i in range(len(stmts) - 1, -1, -1):
-            tails[i] = set(tail)
-            tail |= _reads(stmts[i])
+            tails[i] = set(live_tail)
+            live_tail |= _reads(stmts[i])
         out = []
         i = 0
         while i < len(stmts):
             s = stmts[i]
             rest = stmts[i + 1:]
-            if (isinstance(s, ast.If) and not s.orelse
-                    and s.body and isinstance(s.body[-1], ast.Return)):
-                # `if c: ...; return x` followed by <rest> is exactly
-                # `if c: ...; return x / else: <rest>` (and an implicit
-                # `return None` when nothing follows) — fold so the
-                # two-sided return rewrite below can fire
-                orelse = list(rest) if rest \
-                    else [ast.Return(value=ast.Constant(value=None))]
-                folded = ast.If(test=s.test, body=s.body, orelse=orelse)
-                self._live.append(outer_live)
-                out.extend(self._process_stmt(folded))
-                self._live.pop()
-                return out
+            if isinstance(s, ast.If):
+                b_ret = _ends_in_return(s.body)
+                o_ret = bool(s.orelse) and _ends_in_return(s.orelse)
+                folded = None
+                if b_ret != o_ret:
+                    # one branch always returns: the statements after the If
+                    # run exactly when the other branch was taken — fold them
+                    # into it so the two-sided tail-return rewrite can fire.
+                    # With nothing following, falling past the If ends the
+                    # function ONLY in tail position (`return None`).
+                    if rest:
+                        if b_ret:
+                            folded = ast.If(test=s.test, body=s.body,
+                                            orelse=(s.orelse or [])
+                                            + list(rest))
+                        else:
+                            folded = ast.If(test=s.test,
+                                            body=s.body + list(rest),
+                                            orelse=s.orelse)
+                    elif tail:
+                        implicit = [ast.Return(value=ast.Constant(value=None))]
+                        if b_ret:
+                            folded = ast.If(test=s.test, body=s.body,
+                                            orelse=(s.orelse or [])
+                                            + implicit)
+                        else:
+                            folded = ast.If(test=s.test,
+                                            body=s.body + implicit,
+                                            orelse=s.orelse)
+                if folded is not None:
+                    ast.copy_location(folded, s)
+                    self._live.append(outer_live)
+                    self._stmt_tail.append(tail)
+                    out.extend(self._process_stmt(folded))
+                    self._stmt_tail.pop()
+                    self._live.pop()
+                    return out
             self._live.append(tails[i])
+            self._stmt_tail.append(tail and i == len(stmts) - 1)
             out.extend(self._process_stmt(s))
+            self._stmt_tail.pop()
             self._live.pop()
             i += 1
         return out
@@ -565,52 +720,98 @@ class ControlFlowTransformer(ast.NodeTransformer):
         params = {a.arg for a in node.args.args}
         self._range_shadowed = ("range" in _assigned_names(node.body)
                                 or "range" in params)
-        node.body = self._process_block(node.body)
+        node.body = self._process_block(node.body, tail=True)
         self._range_shadowed = prev
         return node
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
+    # -- call-site conversion ------------------------------------------------
+    def visit_Call(self, node):
+        """user_call(args) -> __pt_jst__.convert_call(user_call)(args):
+        callees get converted too (tensor control flow in helpers compiles;
+        unconvertible helpers lose only themselves). Runtime attrs and
+        common builtins stay direct."""
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _DIRECT_CALLS:
+            return node
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id == _RUNTIME_NAME):
+            return node
+        node.func = ast.Call(func=_runtime_attr("convert_call"),
+                             args=[f], keywords=[])
+        return node
+
     # -- if ------------------------------------------------------------------
     def visit_If(self, node):
+        # synthesized guards carry their own loop-derived region id; only
+        # source constructs consume the main counter (id stability across
+        # re-conversions with different skip sets)
+        uid = getattr(node, "_pt_region", None)
+        if uid is None:
+            uid = self._uid()
+        line = getattr(node, "lineno", 0)
         # raw reads BEFORE conversion: the generated inner carries read
         # their UNDEF-guarded names structurally, which must not count as
         # pre-branch uses
         raw_reads = _reads(node.body) | _reads(node.orelse)
         node.test = self.visit(node.test)
-        node.body = self._process_block(node.body)
-        node.orelse = self._process_block(node.orelse)
+        stmt_tail = self._stmt_tail[-1] if self._stmt_tail else False
+        node.body = self._process_block(node.body, tail=stmt_tail)
+        node.orelse = self._process_block(node.orelse, tail=stmt_tail)
 
         body_f = _ctlflow(node.body)
         else_f = _ctlflow(node.orelse)
+        if uid in self._skip:
+            self._note("if", line, uid, "python", "fell back at trace time")
+            return node
 
-        # two-sided single-return: `if c: return A else: return B`
-        if (len(node.body) == 1 and isinstance(node.body[0], ast.Return)
-                and len(node.orelse) == 1
-                and isinstance(node.orelse[0], ast.Return)):
-            a = node.body[0].value or ast.Constant(value=None)
-            b = node.orelse[0].value or ast.Constant(value=None)
+        # two-sided tail-return: both branches END in a return — each branch
+        # becomes a function returning its value (subsumes the single-return
+        # `if c: return A else: return B` case; the _process_block folds
+        # normalize one-sided returns into this shape)
+        if (node.orelse and _ends_in_return(node.body)
+                and _ends_in_return(node.orelse)
+                and not _ctlflow(node.body[:-1]).has_return
+                and not _ctlflow(node.orelse[:-1]).has_return
+                and not body_f.has_raise and not else_f.has_raise
+                and not body_f.has_break_continue
+                and not else_f.has_break_continue):
+            names = [n for n in _assigned_names(node.body[:-1]
+                                                + node.orelse[:-1])
+                     if n in raw_reads]
+            tname, fname = f"__pt_true_{uid}", f"__pt_false_{uid}"
+            args = _params(names)
+            tdef = _fn_def(tname, args, _tail_return_body(node.body))
+            fdef = _fn_def(fname, _copy_args(args),
+                           _tail_return_body(node.orelse))
             call = ast.Call(
                 func=_runtime_attr("convert_ifelse"),
-                args=[node.test,
-                      ast.Lambda(args=_empty_args(), body=a),
-                      ast.Lambda(args=_empty_args(), body=b)],
-                keywords=[])
-            return ast.Return(value=call)
+                args=[node.test, _name(tname, ast.Load()),
+                      _name(fname, ast.Load()),
+                      _names_tuple(names, ast.Load())],
+                keywords=[self._region_kw(uid)])
+            self._note("if", line, uid, "converted")
+            return ([tdef, fdef] + _undef_guards(names)
+                    + [ast.Return(value=call)])
 
         if body_f.has_return or else_f.has_return:
-            return node  # mid-branch returns: leave as Python
+            # mid-branch returns the folds couldn't normalize
+            self._note("if", line, uid, "python", "mid-branch return")
+            return node
         if body_f.has_raise or else_f.has_raise:
-            return node  # raising guards: leave as Python (eager fallback)
+            # raising guards: leave as Python (eager fallback)
+            self._note("if", line, uid, "python", "raise in branch")
+            return node
         if body_f.has_break_continue or else_f.has_break_continue:
-            return node  # break/continue belong to an enclosing loop
+            return node  # break/continue: handled by the enclosing loop
 
         # carry = assigned ∩ (read AFTER the if ∪ read INSIDE a branch) —
         # branch-internal reads need the pre-branch value as a parameter
         need = self._live_after() | raw_reads
         names = [n for n in _assigned_names(node.body + node.orelse)
                  if n in need]
-        uid = self._uid()
         tname, fname = f"__pt_true_{uid}", f"__pt_false_{uid}"
         # branch-assigned names come IN as parameters: a name assigned in a
         # branch is local to the generated function, so its pre-branch value
@@ -626,16 +827,101 @@ class ControlFlowTransformer(ast.NodeTransformer):
             args=[node.test, _name(tname, ast.Load()),
                   _name(fname, ast.Load()),
                   _names_tuple(names, ast.Load())],
-            keywords=[])
+            keywords=[self._region_kw(uid)])
         if names:
             assign = ast.Assign(targets=[_names_tuple(names, ast.Store())],
                                 value=call)
         else:
             assign = ast.Expr(value=call)
+        self._note("if", line, uid, "converted")
         return [tdef, fdef] + _undef_guards(names) + [assign]
+
+    # -- break/continue desugaring -------------------------------------------
+    def _desugar_loop_body(self, stmts, brk, cont):
+        """Rewrite break/continue at THIS loop level into guard-variable
+        assignments, wrapping the statements after a guard-setting `if` in
+        `if not (brk or cont):` (reference
+        `transformers/break_continue_transformer.py:87` bool guard vars).
+        Returns the new statement list, or None when a break/continue sits
+        under an unsupported container (try/with) at this level."""
+        out = []
+        for i, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(_assign_const(brk, True))
+                return out  # statements after a bare break are unreachable
+            if isinstance(s, ast.Continue):
+                out.append(_assign_const(cont, True))
+                return out
+            f = _ctlflow([s])
+            if f.has_break_continue:
+                if not isinstance(s, ast.If):
+                    return None  # break under try/with at this level
+                body = self._desugar_loop_body(s.body, brk, cont)
+                orelse = self._desugar_loop_body(s.orelse, brk, cont)
+                if body is None or orelse is None:
+                    return None
+                out.append(ast.copy_location(
+                    ast.If(test=s.test, body=body or [ast.Pass()],
+                           orelse=orelse), s))
+                rest = self._desugar_loop_body(stmts[i + 1:], brk, cont)
+                if rest is None:
+                    return None
+                if rest:
+                    guard = ast.If(test=self._guard_expr(brk, cont),
+                                   body=rest, orelse=[])
+                    # synthesized guards get a region id DERIVED from the
+                    # owning loop, off the main uid counter: whether a loop
+                    # desugars depends on the skip set, so letting guards
+                    # consume main-counter uids would shift every later
+                    # region's id across re-conversions
+                    self._synth_seq += 1
+                    guard._pt_region = ("s", self._synth_loop,
+                                        self._synth_seq)
+                    out.append(guard)
+                return out
+            out.append(s)
+        return out
+
+    def _guard_expr(self, brk, cont):
+        names = [n for n in (brk, cont) if n is not None]
+        e = _name(names[0], ast.Load())
+        if len(names) == 2:
+            e = ast.BoolOp(op=ast.Or(),
+                           values=[e, _name(names[1], ast.Load())])
+        return ast.UnaryOp(op=ast.Not(), operand=e)
 
     # -- while ---------------------------------------------------------------
     def visit_While(self, node):
+        uid = self._uid()
+        line = getattr(node, "lineno", 0)
+        inits = []
+        f0 = _ctlflow(node.body)
+        if (f0.has_break_continue and not f0.has_return and not f0.has_raise
+                and not node.orelse and uid not in self._skip):
+            brk = f"_jst_brk{uid}" if f0.has_break else None
+            cont = f"_jst_cont{uid}" if f0.has_continue else None
+            self._synth_loop, self._synth_seq = uid, 0
+            new_body = self._desugar_loop_body(node.body, brk, cont)
+            if new_body is not None:
+                # guards are ordinary loop state: initialized before the
+                # loop, cont reset each iteration, brk folded into the test
+                inits = [_assign_const(g, False) for g in (brk, cont) if g]
+                if cont:
+                    new_body = [_assign_const(cont, False)] + new_body
+                test = node.test
+                if brk:
+                    test = ast.BoolOp(op=ast.And(), values=[
+                        ast.UnaryOp(op=ast.Not(),
+                                    operand=_name(brk, ast.Load())),
+                        test])
+                node = ast.copy_location(
+                    ast.While(test=test, body=new_body, orelse=[]), node)
+        out = self._finish_while(node, uid, line)
+        if inits:
+            return inits + (out if isinstance(out, list) else [out])
+        return out
+
+    def _finish_while(self, node, uid, line):
         node.test = self.visit(node.test)
         # the loop BACK EDGE makes every body/test read live after every
         # body statement (next iteration reads it)
@@ -646,14 +932,23 @@ class ControlFlowTransformer(ast.NodeTransformer):
         node.orelse = self._process_block(node.orelse)
 
         f = _ctlflow(node.body)
+        if uid in self._skip:
+            self._note("while", line, uid, "python",
+                       "fell back at trace time")
+            return node
         if f.has_return or f.has_break_continue or f.has_raise or node.orelse:
+            reason = ("return in loop body" if f.has_return
+                      else "break/continue under try/with"
+                      if f.has_break_continue
+                      else "raise in loop body" if f.has_raise
+                      else "while-else")
+            self._note("while", line, uid, "python", reason)
             return node
         need = back_edge  # raw body/test reads captured pre-conversion
         names = [n for n in _assigned_names(node.body) if n in need]
         if not names:
             return node  # side-effect-only loop: nothing to carry
 
-        uid = self._uid()
         cname, bname = f"__pt_cond_{uid}", f"__pt_body_{uid}"
         args = ast.arguments(
             posonlyargs=[], args=[ast.arg(arg=n) for n in names],
@@ -668,9 +963,10 @@ class ControlFlowTransformer(ast.NodeTransformer):
             func=_runtime_attr("convert_while"),
             args=[_name(cname, ast.Load()), _name(bname, ast.Load()),
                   _names_tuple(names, ast.Load())],
-            keywords=[])
+            keywords=[self._region_kw(uid)])
         assign = ast.Assign(targets=[_names_tuple(names, ast.Store())],
                             value=call)
+        self._note("while", line, uid, "converted")
         return [cdef, bdef] + guards + [assign]
 
     # -- for-range -----------------------------------------------------------
@@ -678,31 +974,77 @@ class ControlFlowTransformer(ast.NodeTransformer):
         """`for i in range(...)` -> the while conversion (reference
         loop_transformer for->while): tensor bounds become a
         lax.while_loop; concrete bounds keep Python unrolling via
-        convert_while's Python path. Non-range iterables, tuple targets,
-        and break/continue/return bodies stay untouched."""
-        node.iter = self.visit(node.iter)
+        convert_while's Python path. break/continue bodies are desugared
+        into guard variables first (the guard rides the loop carry and the
+        loop condition). Non-range iterables, tuple targets, and
+        return/raise bodies stay untouched."""
+        uid = self._uid()
+        line = getattr(node, "lineno", 0)
+        # shape check on the RAW iter (visit_Call would wrap the range call)
+        is_range = (not self._range_shadowed
+                    and isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and isinstance(node.target, ast.Name)
+                    and not node.orelse)
+        if is_range:
+            node.iter.args = [self.visit(a) for a in node.iter.args]
+        else:
+            node.iter = self.visit(node.iter)
+        f0 = _ctlflow(node.body)
+        brk = cont = None
+        has_guard = False
+        if (is_range and f0.has_break_continue and not f0.has_return
+                and not f0.has_raise and uid not in self._skip):
+            # desugar ONLY when the loop will definitely convert: a
+            # desugared body without the condition guard would keep
+            # iterating past a break
+            brk = f"_jst_brk{uid}" if f0.has_break else None
+            cont = f"_jst_cont{uid}" if f0.has_continue else None
+            self._synth_loop, self._synth_seq = uid, 0
+            new_body = self._desugar_loop_body(node.body, brk, cont)
+            if new_body is not None:
+                if cont:
+                    new_body = [_assign_const(cont, False)] + new_body
+                node = ast.copy_location(
+                    ast.For(target=node.target, iter=node.iter,
+                            body=new_body, orelse=[]), node)
+                # only BREAK alters the trip count; continue-only loops may
+                # still unroll in Python (keeps the index concrete)
+                has_guard = brk is not None
+            else:
+                brk = cont = None
         back_edge = (_reads(node.body) | {node.target.id}
                      if isinstance(node.target, ast.Name)
                      else _reads(node.body)) | self._live_after()
+        # the break guard is read by the SYNTHESIZED loop condition, which
+        # liveness over the body text cannot see — force it live so the
+        # desugared `brk = True` branch carries it out
+        back_edge |= {n for n in (brk, cont) if n}
         self._live.append(back_edge)
         node.body = self._process_block(node.body)
         self._live.pop()
         node.orelse = self._process_block(node.orelse)
-        if self._range_shadowed:
-            return node  # user rebound `range`: leave Python semantics
-        if not (isinstance(node.iter, ast.Call)
-                and isinstance(node.iter.func, ast.Name)
-                and node.iter.func.id == "range"
-                and not node.iter.keywords
-                and 1 <= len(node.iter.args) <= 3
-                and isinstance(node.target, ast.Name)
-                and not node.orelse):
+        if uid in self._skip:
+            self._note("for", line, uid, "python", "fell back at trace time")
+            return node
+        if not is_range:
+            if self._range_shadowed or not isinstance(node.iter, ast.Call):
+                return node  # plain iteration: no conversion intended
+            self._note("for", line, uid, "python",
+                       "non-range iterable, tuple target, or for-else")
             return node
         f = _ctlflow(node.body)
         if f.has_return or f.has_break_continue or f.has_raise:
+            reason = ("return in loop body" if f.has_return
+                      else "break/continue under try/with"
+                      if f.has_break_continue
+                      else "raise in loop body")
+            self._note("for", line, uid, "python", reason)
             return node
 
-        uid = self._uid()
         tgt = node.target.id
         rname = f"__pt_range_{uid}"
         cname = f"__pt_i_{uid}"  # internal counter: the user target is
@@ -710,8 +1052,13 @@ class ControlFlowTransformer(ast.NodeTransformer):
         # holds Python's last body value and a zero-trip loop leaves it
         # unbound (exact for-semantics)
         need = back_edge  # raw body reads captured pre-conversion
+        forced = [n for n in (brk, cont) if n]  # guards always ride the
+        # carry: brk feeds the condition even when nothing reads it in-body
         names = [cname, tgt] + [n for n in _assigned_names(node.body)
-                                if n != tgt and n in need]
+                                if n != tgt and (n in need or n in forced)]
+        for n in forced:
+            if n not in names:
+                names.append(n)
         args = _params(names)
         r_assign = ast.Assign(
             targets=[_name(rname, ast.Store())],
@@ -721,12 +1068,19 @@ class ControlFlowTransformer(ast.NodeTransformer):
             targets=[_name(cname, ast.Store())],
             value=ast.Attribute(value=_name(rname, ast.Load()),
                                 attr="start", ctx=ast.Load()))
-        cdef = _fn_def(
-            f"__pt_fcond_{uid}", args,
-            [ast.Return(value=ast.Call(
-                func=_runtime_attr("range_continue"),
-                args=[_name(cname, ast.Load()), _name(rname, ast.Load())],
-                keywords=[]))])
+        guard_inits = [_assign_const(g, False) for g in forced]
+        cond_expr = ast.Call(
+            func=_runtime_attr("range_continue"),
+            args=[_name(cname, ast.Load()), _name(rname, ast.Load())],
+            keywords=[])
+        if brk:
+            # `not brk and in_range` — visit converts it to the thunked
+            # logical ops so a traced guard composes into the lax condition
+            cond_expr = self.visit(ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(), operand=_name(brk, ast.Load())),
+                cond_expr]))
+        cdef = _fn_def(f"__pt_fcond_{uid}", args,
+                       [ast.Return(value=cond_expr)])
         set_tgt = ast.Assign(targets=[_name(tgt, ast.Store())],
                              value=_name(cname, ast.Load()))
         bump = ast.Assign(
@@ -745,10 +1099,14 @@ class ControlFlowTransformer(ast.NodeTransformer):
                   _name(f"__pt_fbody_{uid}", ast.Load()),
                   _names_tuple(names, ast.Load()),
                   _name(rname, ast.Load())],
-            keywords=[])
+            keywords=[self._region_kw(uid)]
+            + ([ast.keyword(arg="has_guard",
+                            value=ast.Constant(value=True))]
+               if has_guard else []))
         assign = ast.Assign(targets=[_names_tuple(names, ast.Store())],
                             value=call)
-        return ([r_assign, i_init, cdef, bdef]
+        self._note("for", line, uid, "converted")
+        return ([r_assign, i_init, cdef, bdef] + guard_inits
                 + _undef_guards(names[1:]) + [assign])
 
     # -- bool ops ------------------------------------------------------------
@@ -823,20 +1181,45 @@ def _copy_ret(r):
 
 _CACHE_ATTR = "__pt_dy2static_converted__"
 
+# the active per-region fallback blacklist — set by StaticFunction around
+# build/trace so convert_call-converted CALLEES observe the same skip set
+# (regions are namespaced by module.qualname, so sets compose safely)
+import contextvars as _contextvars
 
-def convert_function(fn):
+_ACTIVE_SKIP = _contextvars.ContextVar("dy2static_skip_regions",
+                                       default=frozenset())
+
+
+def _fn_region_ns(raw):
+    return f"{getattr(raw, '__module__', '?')}.{raw.__qualname__}"
+
+
+def convert_function(fn, skip_regions=None):
     """Best-effort AST conversion of `fn`. Returns the converted function,
     or `fn` unchanged when source is unavailable or conversion fails.
     The converted function is a drop-in replacement in eager execution
-    (concrete predicates take the Python path of the converted ops)."""
-    cached = getattr(fn, _CACHE_ATTR, None)
-    if cached is not None:
+    (concrete predicates take the Python path of the converted ops).
+
+    skip_regions: set of (namespace, uid) regions to leave as ordinary
+    Python (per-region fallback). Defaults to the active blacklist of the
+    enclosing StaticFunction (contextvar), so callees converted at call
+    sites honor it too."""
+    if skip_regions is None:
+        skip_regions = _ACTIVE_SKIP.get()
+    raw = fn.__func__ if isinstance(fn, types.MethodType) else fn
+    try:
+        ns_key = _fn_region_ns(raw)
+    except AttributeError:
+        return fn
+    rel = frozenset(uid for qn, uid in skip_regions if qn == ns_key)
+    cache = getattr(raw, _CACHE_ATTR, None)
+    if cache is not None and rel in cache:
         # the cache lives on the underlying function (shared across
         # instances for methods) — rebind to THIS instance on a hit
+        cached = cache[rel]
         if isinstance(fn, types.MethodType):
             return types.MethodType(cached, fn.__self__)
         return cached
-    raw = fn.__func__ if isinstance(fn, types.MethodType) else fn
     if hasattr(raw, "__wrapped__"):
         # functools.wraps-style wrapper: getsource would unwrap to the
         # ORIGINAL def and conversion would silently drop the wrapper's
@@ -852,7 +1235,8 @@ def convert_function(fn):
         if fdef.name != raw.__name__:
             return fn  # source doesn't correspond to this function
         fdef.decorator_list = []  # don't re-apply @to_static and friends
-        new_tree = ControlFlowTransformer().visit(tree)
+        tr = ControlFlowTransformer(skip_uids=rel, qualname=ns_key)
+        new_tree = tr.visit(tree)
         ast.fix_missing_locations(new_tree)
         ns = dict(raw.__globals__)
         from paddle_tpu.jit import dy2static as _rt
@@ -886,11 +1270,16 @@ def convert_function(fn):
                                  assigned=("__name__", "__doc__",
                                            "__qualname__"), updated=())
         del new_fn.__wrapped__  # set by update_wrapper; see bail-out above
+        new_fn.__pt_dy2static_report__ = {"namespace": ns_key,
+                                          "regions": tr.report}
     except (OSError, TypeError, SyntaxError, ValueError, IndentationError,
             AttributeError, KeyError):
         return fn
     try:
-        setattr(raw, _CACHE_ATTR, new_fn)
+        if cache is None:
+            cache = {}
+            setattr(raw, _CACHE_ATTR, cache)
+        cache[rel] = new_fn
     except (AttributeError, TypeError):
         pass
     if isinstance(fn, types.MethodType):

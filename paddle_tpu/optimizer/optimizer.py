@@ -280,7 +280,11 @@ class Adam(Optimizer):
             import jax
 
             from paddle_tpu.core.numerics import stochastic_round_bf16
-            key = jax.random.fold_in(jax.random.key(t), id(p) & 0x7FFFFFFF)
+            # stable per-param slot (encounter order), NOT id(p): the noise
+            # stream must be reproducible across processes and collision-free
+            slots = self.__dict__.setdefault("_sr_slots", {})
+            slot = slots.setdefault(id(p), len(slots))
+            key = jax.random.fold_in(jax.random.key(t), slot)
             self._set_acc("moment1", p, stochastic_round_bf16(
                 jax.random.fold_in(key, 0), m))
             self._set_acc("moment2", p, stochastic_round_bf16(

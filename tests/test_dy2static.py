@@ -531,3 +531,257 @@ def test_shadowed_range_keeps_python_semantics():
 
     sf = to_static(f)
     np.testing.assert_allclose(sf(_t([0.0])).numpy(), [4.0])
+
+
+# -- r5: break/continue, mid-branch returns, per-region fallback, ------------
+# -- convert_call, reports (VERDICT r4 item 2) -------------------------------
+
+
+def test_while_break_on_tensor_condition():
+    """`break` on a tensor condition compiles into ONE lax.while_loop via
+    the bool-guard desugar (reference
+    break_continue_transformer.py:87)."""
+
+    def f(x):
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 100.0:
+            x = x + 1.0
+            if x.sum() > 10.0:
+                break
+            i = i + 1.0
+        return x
+
+    def eager(x0):
+        x = np.asarray(x0, np.float32)
+        i = 0.0
+        while i < 100.0:
+            x = x + 1.0
+            if x.sum() > 10.0:
+                break
+            i = i + 1.0
+        return x
+
+    sf = to_static(f)
+    outs = assert_no_fallback(sf, (_t([1.0, 2.0]),), (_t([-50.0, 0.0]),))
+    np.testing.assert_allclose(outs[0].numpy(), eager([1.0, 2.0]))
+    np.testing.assert_allclose(outs[2].numpy(), eager([-50.0, 0.0]))
+    # the conversion captured the loop (it did not stay Python)
+    rep = sf.conversion_report()
+    kinds = {(r["kind"], r["status"]) for r in rep["report"]["regions"]}
+    assert ("while", "converted") in kinds, rep
+
+
+def test_while_continue_and_trailing_statements():
+    def f(x):
+        i = paddle.to_tensor(np.float32(0.0))
+        acc = paddle.zeros_like(x)
+        while i < 6.0:
+            i = i + 1.0
+            if (i % 2.0) < 1.0:
+                continue
+            acc = acc + x * i  # runs for odd i only
+        return acc
+
+    def eager(x0):
+        x = np.asarray(x0, np.float32)
+        acc = np.zeros_like(x)
+        i = 0.0
+        while i < 6.0:
+            i += 1.0
+            if (i % 2.0) < 1.0:
+                continue
+            acc = acc + x * i
+        return acc
+
+    sf = to_static(f)
+    outs = assert_no_fallback(sf, (_t([1.0, 3.0]),))
+    np.testing.assert_allclose(outs[0].numpy(), eager([1.0, 3.0]), rtol=1e-6)
+
+
+def test_for_range_break_tensor_condition():
+    def f(x):
+        for i in range(100):
+            x = x + 1.0
+            if x.sum() > 9.0:
+                break
+        return x, i
+
+    sf = to_static(f)
+    outs = assert_no_fallback(sf, (_t([0.0, 0.0]),))
+    x, i = outs[0]
+    # eager: sum grows by 2 per step; exceeds 9 at step 5 (sum=10), i=4
+    np.testing.assert_allclose(x.numpy(), [5.0, 5.0])
+    assert int(np.asarray(i.numpy() if hasattr(i, "numpy") else i)) == 4
+
+
+def test_for_range_continue_parity():
+    def f(x):
+        acc = paddle.zeros_like(x)
+        for i in range(8):
+            if (paddle.to_tensor(np.float32(i)) % 2.0) < 1.0:
+                continue
+            acc = acc + x * float(i)
+        return acc
+
+    sf = to_static(f)
+    outs = assert_no_fallback(sf, (_t([1.0]),))
+    np.testing.assert_allclose(outs[0].numpy(), [1 + 3 + 5 + 7.0])
+
+
+def test_mid_branch_return_with_trailing_code():
+    """One branch returns, trailing statements fold into the other side and
+    the whole thing compiles (reference ifelse return transformation)."""
+
+    def f(x):
+        if x.sum() > 0.0:
+            y = x * 2.0
+            return y + 1.0
+        z = x - 1.0
+        z = z * 3.0
+        return z
+
+    sf = to_static(f)
+    outs = assert_no_fallback(sf, (_t([1.0]),), (_t([-1.0]),))
+    np.testing.assert_allclose(outs[0].numpy(), [3.0])   # 1*2+1
+    np.testing.assert_allclose(outs[2].numpy(), [-6.0])  # (-1-1)*3
+
+
+def test_mid_branch_return_nested():
+    def f(x):
+        if x.sum() > 0.0:
+            if x.sum() > 10.0:
+                return x * 100.0
+            return x * 10.0
+        return x
+
+    sf = to_static(f)
+    outs = assert_no_fallback(
+        sf, (_t([20.0]),), (_t([1.0]),), (_t([-1.0]),))
+    np.testing.assert_allclose(outs[0].numpy(), [2000.0])
+    np.testing.assert_allclose(outs[2].numpy(), [10.0])
+    np.testing.assert_allclose(outs[4].numpy(), [-1.0])
+
+
+def test_nested_return_falls_through_in_non_tail_block():
+    """Regression: a `if c: return` nested in a NON-TAIL block must fall
+    through to the code after the enclosing region when c is false — the
+    pre-r5 fold appended an implicit `return None` there, which returned
+    None instead of z. (This shape needs the reference's full return-flag
+    transformer to COMPILE; correctness first, graceful eager degrade is
+    acceptable.)"""
+
+    def f(x, flag):
+        if flag:  # concrete python bool: stays a Python if (static arg)
+            if x.sum() > 100.0:
+                return x * 0.0
+            # falls through to z below when sum <= 100
+        z = x + 1.0
+        return z
+
+    sf = to_static(f)
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        o1 = sf(_t([1.0]), True)
+        o2 = sf(_t([1.0]), False)
+        o3 = sf(_t([200.0]), True)
+    np.testing.assert_allclose(o1.numpy(), [2.0])
+    np.testing.assert_allclose(o2.numpy(), [2.0])
+    np.testing.assert_allclose(o3.numpy(), [0.0])
+
+
+def test_static_python_args_recompile_per_value():
+    """Non-tensor args are compile-time constants (the reference bakes
+    non-tensor arguments into the program): each value gets its own
+    compiled program and concrete branches keep Python semantics."""
+    calls = []
+
+    def f(x, flag):
+        if flag:
+            calls.append("t")
+            return x + 1
+        calls.append("f")
+        return x - 1
+
+    sf = to_static(f)
+    o1 = sf(_t([1.0]), True)
+    o2 = sf(_t([1.0]), False)
+    np.testing.assert_allclose(o1.numpy(), [2.0])
+    np.testing.assert_allclose(o2.numpy(), [0.0])
+    assert calls == ["t", "f"]  # one trace each; untaken branch never ran
+
+
+def test_per_region_fallback_keeps_callable_compiled():
+    """A region that cannot compile (carry shape grows across iterations)
+    with CONCRETE trip conditions falls back alone; the callable stays
+    compiled (fallback_count flat) and reports the region."""
+    from paddle_tpu.jit import fallback_report
+
+    def f(x, n):
+        out = x
+        i = 0
+        while i < n:  # concrete python ints drive the loop
+            out = paddle.concat([out, out])  # shape grows: not lax-able
+            i = i + 1
+        return out.sum() + x.sum()
+
+    base = fallback_count()
+    sf = to_static(f)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out = sf(_t([1.0, 2.0]), 3)
+    np.testing.assert_allclose(float(out.numpy()), 8 * 3.0 + 3.0)
+    assert fallback_count() == base, "whole callable degraded"
+    assert any("retrying with it as ordinary Python" in str(w.message)
+               for w in rec)
+    rep = sf.conversion_report()
+    assert rep["fallback_regions"], rep
+    assert not rep["eager_only"]
+    assert any(r["event"] == "region" and r["name"] == "f"
+               for r in fallback_report())
+
+
+def test_convert_call_nested_helper():
+    """Tensor control flow in a HELPER function compiles via call-site
+    conversion (reference convert_call)."""
+
+    def helper(v):
+        if v.sum() > 0.0:
+            return v * 2.0
+        return v * -1.0
+
+    def f(x):
+        y = helper(x)
+        return y + helper(y)
+
+    sf = to_static(f)
+    outs = assert_no_fallback(sf, (_t([1.0]),), (_t([-1.0]),))
+    np.testing.assert_allclose(outs[0].numpy(), [6.0])    # 2 + 4
+    np.testing.assert_allclose(outs[2].numpy(), [3.0])    # 1 + 2
+
+
+def test_convert_call_user_sublayer():
+    """A user sublayer with tensor-dependent forward compiles when called
+    from a converted forward."""
+
+    class Gate(nn.Layer):
+        def forward(self, v):
+            if v.mean() > 0.0:
+                return v
+            return v * 0.0
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+            self.gate = Gate()
+
+        def forward(self, x):
+            return self.gate(self.fc(x)).sum()
+
+    net = Net()
+    sf = to_static(net)
+    x = _t(np.ones((2, 4), np.float32))
+    outs = assert_no_fallback(sf, (x,))
+    # parity with eager
+    eager = float(net(x).numpy())
+    np.testing.assert_allclose(float(outs[0].numpy()), eager, rtol=1e-5)
