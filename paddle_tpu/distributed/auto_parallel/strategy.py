@@ -118,6 +118,12 @@ class Strategy(BaseConfig):
             "fused_passes", cfg.pop("fused_passes", None))
         self.tuning = TuningConfig("tuning", cfg.pop("tuning", None))
         for k, v in cfg.items():
+            # unknown blocks are kept for introspection but announced —
+            # nothing may be silently dropped (VERDICT r4 item 4)
+            import warnings
+
+            warnings.warn(f"Strategy: unknown config block {k!r} is stored "
+                          "but not consumed by the Engine")
             setattr(self, k, v)
 
     def copy(self):

@@ -239,12 +239,28 @@ class Engine:
 
     def __init__(self, model, loss=None, optimizer=None, strategy=None,
                  dp=None, mp=1, sharding_stage=0, mesh=None, devices=None,
-                 mp_spec_fn=None, seed=0):
+                 mp_spec_fn=None, seed=0, amp_level=None, amp_dtype="bfloat16",
+                 remat=False, accumulate_steps=1, accumulate_avg=True):
         from paddle_tpu import jit as pjit
 
         self.model = model
         self.loss_layer = loss
         self.optimizer = optimizer
+        # amp_level 'O1'/'O2': the forward traces under paddle_tpu.amp
+        # autocast (the reference auto_parallel AMP pass, applied at trace
+        # time instead of as a graph pass); loss/grads stay f32
+        if amp_level not in (None, "O1", "O2", "o1", "o2"):
+            raise ValueError("amp_level must be None, 'O1' or 'O2'")
+        self.amp_level = amp_level.upper() if amp_level else None
+        self.amp_dtype = amp_dtype
+        self.remat = bool(remat)  # jax.checkpoint over the whole forward
+        # gradient merge (reference auto_parallel_gradient_merge pass):
+        # split the global batch into k accumulation chunks, one optimizer
+        # step per train_batch
+        if accumulate_steps < 1:
+            raise ValueError("accumulate_steps must be >= 1")
+        self.accumulate_steps = int(accumulate_steps)
+        self.accumulate_avg = bool(accumulate_avg)
         if strategy is not None:  # fleet DistributedStrategy routing
             h = strategy.hybrid_configs
             if h.get("pp_degree", 1) not in (1, None):
@@ -373,12 +389,57 @@ class Engine:
         grad_clip = self._grad_clip
 
         def loss_fn(params, buffers, key, inputs, labels):
+            if self.amp_level:
+                from paddle_tpu import amp as _amp
+
+                with _amp.auto_cast(enable=True, level=self.amp_level,
+                                    dtype=self.amp_dtype):
+                    out, new_buf = self._pure_fn(params, buffers, key,
+                                                 *inputs)
+                    loss = self._loss_of(out, labels)
+                return loss.astype(jnp.float32), new_buf
             out, new_buf = self._pure_fn(params, buffers, key, *inputs)
             return self._loss_of(out, labels), new_buf
 
+        if self.remat:
+            # strategy.recompute: rematerialize the forward in backward
+            # (reference auto_parallel_recompute pass -> jax.checkpoint)
+            loss_fn = jax.checkpoint(loss_fn)
+
+        K = self.accumulate_steps
+
+        def one_chunk(params, buffers, key, inputs, labels):
+            return jax.value_and_grad(loss_fn, has_aux=True)(
+                params, buffers, key, inputs, labels)
+
         def train_step(params, opt_state, buffers, key, lr, inputs, labels):
-            (loss, new_buf), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, buffers, key, inputs, labels)
+            if K == 1:
+                (loss, new_buf), grads = one_chunk(params, buffers, key,
+                                                   inputs, labels)
+            else:
+                # inputs/labels arrive [K, B/K, ...] (placed by train_batch)
+                keys = jax.random.split(key, K)
+                # accumulate in f32: summing K bf16 chunk-gradients in bf16
+                # drops contributions below the running sum's ulp
+                g0 = jax.tree.map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+                def body(carry, xs):
+                    lacc, gacc, buf = carry
+                    k, i, l = xs
+                    (loss, nb), g = one_chunk(params, buf, k, i, l)
+                    gacc = jax.tree.map(
+                        lambda a, b: a + b.astype(jnp.float32), gacc, g)
+                    return (lacc + loss, gacc, nb), None
+
+                (lsum, gsum, new_buf), _ = jax.lax.scan(
+                    body, (jnp.zeros((), jnp.float32), g0, buffers),
+                    (keys, inputs, labels))
+                inv = 1.0 / K
+                loss = lsum * inv
+                scale = inv if self.accumulate_avg else 1.0
+                grads = jax.tree.map(
+                    lambda p, g: (g * scale).astype(p.dtype), params, gsum)
             if grad_clip is not None:
                 grads = grad_clip(grads)
             new_params, new_opt = apply_optimizer_updates(
@@ -394,15 +455,23 @@ class Engine:
         )
         return self._train_step
 
-    def _place_batch(self, arrays):
-        """Host arrays -> device arrays with the leading dim sharded on 'dp'."""
+    def _place_batch(self, arrays, micro=1):
+        """Host arrays -> device arrays with the (per-chunk) batch dim
+        sharded on 'dp'. micro>1 (gradient merge) reshapes [B, ...] ->
+        [micro, B/micro, ...] host-side so the accumulation scan carries a
+        cleanly dp-sharded chunk instead of resharding inside jit."""
         out = []
         for a in arrays:
             a = np.asarray(a.numpy() if hasattr(a, "numpy") else a)
-            if a.shape[0] % self.dp != 0:
+            if a.shape[0] % (self.dp * micro) != 0:
                 raise ValueError(
-                    f"global batch {a.shape[0]} must divide dp={self.dp}")
-            spec = P(*(["dp"] + [None] * (a.ndim - 1)))
+                    f"global batch {a.shape[0]} must divide "
+                    f"dp*accumulate_steps={self.dp * micro}")
+            if micro > 1:
+                a = a.reshape((micro, a.shape[0] // micro) + a.shape[1:])
+                spec = P(*([None, "dp"] + [None] * (a.ndim - 2)))
+            else:
+                spec = P(*(["dp"] + [None] * (a.ndim - 1)))
             out.append(jax.device_put(a, self._sharding(spec)))
         return out
 
@@ -414,8 +483,8 @@ class Engine:
         params, opt_state, buffers = self._state
         self._key, sub = jax.random.split(self._key)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        inputs = self._place_batch(inputs)
-        labels = self._place_batch(labels)
+        inputs = self._place_batch(inputs, micro=self.accumulate_steps)
+        labels = self._place_batch(labels, micro=self.accumulate_steps)
         from paddle_tpu.distributed import comm_monitor as _cm
 
         mon = _cm.get_comm_monitor()

@@ -92,15 +92,37 @@ class Config:
 
     def enable_memory_optim(self, x=True):
         self._enable_memory_optim = x
+        self._noop_warn("enable_memory_optim",
+                        "XLA buffer assignment plans memory unconditionally")
 
     def switch_ir_optim(self, x=True):
-        pass  # XLA pass pipeline always runs
+        if not x:
+            self._noop_warn("switch_ir_optim(False)",
+                            "the XLA pass pipeline cannot be disabled")
 
     def enable_mkldnn(self):
         self._noop_warn("enable_mkldnn", "XLA:CPU replaces oneDNN kernels")
 
     def set_cpu_math_library_num_threads(self, n):
         self._cpu_math_threads = n
+        self._noop_warn("set_cpu_math_library_num_threads",
+                        "XLA:CPU threading is process-global (set "
+                        "XLA_FLAGS=--xla_cpu_multi_thread_eigen before "
+                        "startup)")
+
+    def enable_profile(self):
+        self._noop_warn("enable_profile",
+                        "use paddle_tpu.profiler.Profiler around run() "
+                        "instead")
+
+    def glog_info_disabled(self):
+        return True
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        pass  # feed/fetch ops do not exist in the StableHLO program
+
+    def switch_specify_input_names(self, x=True):
+        pass  # input names always ride the export
 
     def enable_tensorrt_engine(self, *a, **k):
         self._noop_warn("enable_tensorrt_engine",

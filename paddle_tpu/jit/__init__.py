@@ -210,15 +210,32 @@ class StaticFunction:
                 hash(s)
                 return s
             except TypeError:
-                return ("__unhashable__", id(s))
+                # fail LOUDLY: keying a mutable object by id would silently
+                # reuse a program with its OLD contents baked in after a
+                # mutation (pre-r5 jax.jit also rejected such args)
+                raise TypeError(
+                    f"to_static: non-tensor argument {s!r} is unhashable; "
+                    "non-array arguments are compile-time constants and "
+                    "must be hashable (pass data as Tensors/arrays)")
 
         skey = (treedef, tuple(hashable(s) for s in skel))
         return dyn, skel, treedef, skey
+
+    _RETRACE_WARN_AT = 32
 
     def _jit_for(self, skel, treedef, skey):
         jitted = self._jit_cache.get(skey)
         if jitted is not None:
             return jitted
+        if len(self._jit_cache) == self._RETRACE_WARN_AT:
+            import warnings
+
+            warnings.warn(
+                f"to_static({self._name()}): {self._RETRACE_WARN_AT} "
+                "compiled variants — a changing Python scalar argument "
+                "forces a recompile per value (non-tensor arguments are "
+                "compile-time constants); pass it as a Tensor to compile "
+                "once. (The reference SOT's guard-retrace warning.)")
         pure_fn = self._pure_fn
         skel = list(skel)
         layer_mode = self._layer is not None
@@ -319,8 +336,9 @@ class StaticFunction:
         if self._jitted is None and not self._eager_only:
             self._build()
         rep = getattr(self._converted, "__pt_dy2static_report__", None)
+        # key=repr: the set mixes int region ids and synthesized tuple ids
         return {"report": rep,
-                "fallback_regions": sorted(self._skip_regions),
+                "fallback_regions": sorted(self._skip_regions, key=repr),
                 "eager_only": self._eager_only}
 
     # reference-compat introspection
@@ -367,7 +385,7 @@ class TracedLayer:
 
 
 def save(layer, path, input_spec=None, quantize=None, platforms=None,
-         **configs):
+         calib_reader=None, **configs):
     """jit.save (reference `jit/api.py:955`): persist weights + program.
 
     TPU-native format: the program is the layer's forward traced to
@@ -386,6 +404,14 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
     the dequant multiply into the consumer matmul; the math runs bf16/f32
     (weight-only, activations untouched). The Predictor needs no special
     mode: scales ride as extra parameters of the export.
+
+    quantize="int8_ptq" (+ calib_reader=<iterable of input batches>):
+    activation-int8 PTQ — min-max observers calibrate per-layer input
+    scales over the calib batches, then Linear/Conv2D run int8 x int8 ->
+    int32 math in the exported program with the dequant folded into one
+    per-channel output scale (reference
+    `python/paddle/nn/quant/format.py:65,88` LinearQuanter/Dequanter via
+    the analysis-predictor int8 passes).
     """
     import os
     import pickle
@@ -396,42 +422,29 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
     target = layer._layer if isinstance(layer, StaticFunction) else layer
     state = {k: v.numpy() for k, v in target.state_dict().items()}
     meta = {"class": type(target).__name__}
-    if quantize not in (None, "weight_only_int8"):
+    if quantize not in (None, "weight_only_int8", "int8_ptq"):
         raise ValueError(f"unsupported quantize={quantize!r} "
-                         "(None | 'weight_only_int8')")
+                         "(None | 'weight_only_int8' | 'int8_ptq')")
     if quantize is not None and input_spec is None:
         raise ValueError("quantize requires input_spec (the dequant is part "
                          "of the exported program)")
+    ptq_keys, ptq_cm = [], None
+    if quantize == "int8_ptq":
+        if calib_reader is None:
+            raise ValueError("quantize='int8_ptq' requires calib_reader="
+                             "<iterable of input batches> for activation-"
+                             "scale calibration")
+        from paddle_tpu.quantization.ptq_int8 import (calibrate_absmax,
+                                                      int8_patched)
+
+        # calibration runs NOW (eager, unpatched model); the patch itself is
+        # entered right before tracing so an input_spec parse error cannot
+        # leave the live model int8-patched
+        ptq_cm = int8_patched(target, calibrate_absmax(target, calib_reader))
 
     if input_spec is not None:
         from jax import export as jax_export
 
-        pure_fn, params, buffers = functionalize(target)
-
-        qdtypes = {}  # quantized key -> original dtype
-        if quantize == "weight_only_int8":
-            qparams = {}
-            for k, v in params.items():
-                # matmul weights only — like the reference's quant passes,
-                # which rewrite mul/matmul ops and leave lookup tables
-                # float: a gather can't fuse with the dequant multiply, so a
-                # pre-dequantized embedding table would materialize in full
-                # every run
-                if (v.ndim == 2 and min(v.shape) >= 16
-                        and "embed" not in k.lower()
-                        and jnp.issubdtype(v.dtype, jnp.floating)):
-                    a = np.asarray(v, np.float32)
-                    scale = np.maximum(np.abs(a).max(axis=0) / 127.0, 1e-9)
-                    q = np.clip(np.round(a / scale), -127, 127)
-                    qparams[k] = jnp.asarray(q.astype(np.int8))
-                    qparams[k + ".__scale__"] = jnp.asarray(
-                        scale.astype(np.float32))
-                    qdtypes[k] = v.dtype
-                else:
-                    qparams[k] = v
-            params = qparams
-
-        param_keys = list(params.keys())
         input_names = []
         shape_structs = []
         # dynamic dims (None/-1) become jax.export symbolic dimensions so the
@@ -469,6 +482,38 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
         was_training = getattr(target, "training", False)
         target.eval()
         try:
+            if ptq_cm is not None:
+                # live from functionalize (captures int8 weights as params)
+                # through export (traces the int8 forwards)
+                ptq_keys = ptq_cm.__enter__()
+            pure_fn, params, buffers = functionalize(target)
+
+            qdtypes = {}  # quantized key -> original dtype
+            if quantize == "weight_only_int8":
+                qparams = {}
+                for k, v in params.items():
+                    # matmul weights only — like the reference's quant
+                    # passes, which rewrite mul/matmul ops and leave lookup
+                    # tables float: a gather can't fuse with the dequant
+                    # multiply, so a pre-dequantized embedding table would
+                    # materialize in full every run
+                    if (v.ndim == 2 and min(v.shape) >= 16
+                            and "embed" not in k.lower()
+                            and jnp.issubdtype(v.dtype, jnp.floating)):
+                        a = np.asarray(v, np.float32)
+                        scale = np.maximum(np.abs(a).max(axis=0) / 127.0,
+                                           1e-9)
+                        q = np.clip(np.round(a / scale), -127, 127)
+                        qparams[k] = jnp.asarray(q.astype(np.int8))
+                        qparams[k + ".__scale__"] = jnp.asarray(
+                            scale.astype(np.float32))
+                        qdtypes[k] = v.dtype
+                    else:
+                        qparams[k] = v
+                params = qparams
+
+            param_keys = list(params.keys())
+
             def infer_fn(*flat):
                 ps = dict(zip(param_keys, flat[:len(param_keys)]))
                 for k, dt in qdtypes.items():
@@ -491,6 +536,8 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
         finally:
             if was_training:
                 target.train()
+            if ptq_cm is not None:
+                ptq_cm.__exit__(None, None, None)
         meta.update({
             "stablehlo": exported.serialize(),
             "input_names": input_names,
@@ -500,7 +547,9 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
         })
         if quantize is not None:
             meta["quantize"] = quantize
-            meta["quantized_keys"] = sorted(qdtypes)
+            meta["quantized_keys"] = (sorted(qdtypes)
+                                      if quantize == "weight_only_int8"
+                                      else sorted(ptq_keys))
         state = {k: np.asarray(v) for k, v in params.items()}
 
     with open(path + ".pdiparams", "wb") as f:

@@ -1,0 +1,177 @@
+"""Activation-int8 post-training quantization for the export path.
+
+Reference: `python/paddle/nn/quant/format.py:65,88`
+(LinearQuanter/LinearQuanterDequanter — calibrated scales quantize
+activations into int8 graphs) executed by the analysis-predictor int8
+passes (`paddle/fluid/inference/api/analysis_predictor.h:72`).
+
+TPU-native design: instead of graph passes rewriting a ProgramDesc,
+calibration observes per-layer input absmax with eager forward pre-hooks;
+`jit.save(quantize='int8_ptq', calib_reader=...)` then patches each
+quantizable layer's forward so the TRACED program carries int8 weights and
+int8 activation math — `int8 x int8 -> int32` dots that land on the MXU —
+with the dequant folded into one output scale (s_x * s_w per channel).
+The Predictor needs no special mode: the exported StableHLO is
+self-contained.
+"""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["calibrate_absmax", "int8_patched"]
+
+
+def _quantizable(sub):
+    from paddle_tpu import nn
+
+    if not isinstance(sub, (nn.Linear, nn.Conv2D)):
+        return False
+    w = getattr(sub, "weight", None)
+    return w is not None and w._data.ndim in (2, 4) and \
+        jnp.issubdtype(w._data.dtype, jnp.floating)
+
+
+def calibrate_absmax(model, calib_reader, max_batches=32):
+    """Min-max observer calibration: run eager forwards over calib batches,
+    recording each quantizable layer's input absmax. Returns
+    {sublayer_name: absmax}. (Reference PTQ observer pass,
+    `python/paddle/quantization/ptq.py` + AbsmaxObserver.)"""
+    stats = {}
+    handles = []
+    seen = set()
+    for name, sub in model.named_sublayers(include_self=True):
+        if not _quantizable(sub) or id(sub) in seen:
+            continue  # a sublayer aliased under two parents observes once
+        seen.add(id(sub))
+
+        def mk(nm):
+            def hook(layer, inputs):
+                x = inputs[0]
+                xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                m = float(jnp.max(jnp.abs(xd.astype(jnp.float32))))
+                stats[nm] = max(stats.get(nm, 0.0), m)
+
+            return hook
+
+        handles.append(sub.register_forward_pre_hook(mk(name)))
+    was_training = getattr(model, "training", False)
+    model.eval()
+    try:
+        n = 0
+        for batch in calib_reader:
+            if n >= max_batches:
+                break
+            if not isinstance(batch, (list, tuple)):
+                batch = (batch,)
+            model(*[b if isinstance(b, Tensor) else Tensor(jnp.asarray(b))
+                    for b in batch])
+            n += 1
+        if n == 0:
+            raise ValueError("int8_ptq calibration: calib_reader yielded "
+                             "no batches")
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            model.train()
+    return stats
+
+
+def _q_linear_forward(layer, s_x, s_w):
+    def fwd(x):
+        xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        od = xd.dtype
+        xq = jnp.clip(jnp.round(xd.astype(jnp.float32) / s_x),
+                      -127, 127).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            xq, layer.weight._data,
+            (((xd.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (s_x * s_w)
+        if layer.bias is not None:
+            y = y + layer.bias._data.astype(jnp.float32)
+        return Tensor(y.astype(od))
+
+    return fwd
+
+
+def _q_conv2d_forward(layer, s_x, s_w):
+    def fwd(x):
+        xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        od = xd.dtype
+        xq = jnp.clip(jnp.round(xd.astype(jnp.float32) / s_x),
+                      -127, 127).astype(jnp.int8)
+        pad = layer._padding
+        if isinstance(pad, int):
+            pad = [(pad, pad)] * 2
+        elif isinstance(pad, (list, tuple)) and \
+                all(isinstance(p, int) for p in pad):
+            pad = [(int(p), int(p)) for p in pad]
+        acc = jax.lax.conv_general_dilated(
+            xq, layer.weight._data,
+            window_strides=tuple(layer._stride),
+            padding=pad,
+            rhs_dilation=tuple(layer._dilation),
+            feature_group_count=layer._groups,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (s_x * s_w)[None, :, None, None]
+        if layer.bias is not None:
+            y = y + layer.bias._data.astype(jnp.float32)[None, :, None, None]
+        return Tensor(y.astype(od))
+
+    return fwd
+
+
+@contextlib.contextmanager
+def int8_patched(model, stats):
+    """Within the context, every calibrated quantizable sublayer holds an
+    int8 weight and a forward doing int8 activation math; on exit the
+    float weights and original forwards are restored. Yields the list of
+    quantized weight param names (state_dict keys)."""
+    from paddle_tpu import nn
+
+    saved = []
+    qkeys = []
+    seen = set()
+    try:
+        for name, sub in model.named_sublayers(include_self=True):
+            if not _quantizable(sub) or name not in stats \
+                    or id(sub) in seen:
+                # aliased sublayers patch once — a second pass would
+                # re-quantize the already-int8 weight into garbage
+                continue
+            seen.add(id(sub))
+            w = sub.weight
+            wd = np.asarray(w._data, np.float32)
+            if isinstance(sub, nn.Linear):  # weight [in, out]
+                s_w = np.maximum(np.abs(wd).max(axis=0), 1e-9) / 127.0
+                q = np.clip(np.round(wd / s_w), -127, 127)
+            else:  # conv weight [out, in/g, kh, kw]
+                s_w = np.maximum(
+                    np.abs(wd).reshape(wd.shape[0], -1).max(axis=1),
+                    1e-9) / 127.0
+                q = np.clip(np.round(wd / s_w[:, None, None, None]),
+                            -127, 127)
+            s_x = jnp.float32(max(stats[name], 1e-9) / 127.0)
+            s_wj = jnp.asarray(s_w.astype(np.float32))
+            saved.append((sub, "forward" in sub.__dict__,
+                          sub.__dict__.get("forward"), w._data))
+            w._data = jnp.asarray(q.astype(np.int8))
+            mk = (_q_linear_forward if isinstance(sub, nn.Linear)
+                  else _q_conv2d_forward)
+            sub.forward = mk(sub, s_x, s_wj)
+            qkeys.append(f"{name}.weight" if name else "weight")
+        yield qkeys
+    finally:
+        for sub, had_attr, fwd, wd in saved:
+            if had_attr:
+                sub.forward = fwd
+            else:
+                sub.__dict__.pop("forward", None)
+            sub.weight._data = wd

@@ -89,8 +89,9 @@ def test_annotation_completion_mp():
                  strategy=Strategy({"mp_optimization": {"enable": True,
                                                         "degree": 2}}))
     eng.prepare()
-    spec_fn = eng._annotated_spec_fn()
+    spec_fn, user_mesh = eng._annotated_spec_fn()
     assert spec_fn is not None
+    assert user_mesh is None  # single-axis: renamed onto 'mp'
     found = {n: spec_fn(n, None) for n, _ in model.named_parameters()}
     key = [n for n, s in found.items() if s is not None]
     assert len(key) == 1 and key[0].endswith("weight"), found
@@ -148,3 +149,156 @@ def test_strategy_defaults_match_reference():
     assert st.pipeline.schedule_mode == "1F1B"
     st2 = Strategy({"sharding": {"enable": True, "stage": 2, "degree": 2}})
     assert st2.sharding.stage == 2 and st2.sharding.degree == 2
+
+
+# -- r5: every Strategy/Config knob honest (VERDICT r4 item 4) ---------------
+
+
+def test_engine_rejects_cluster():
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+
+    with pytest.raises(NotImplementedError, match="cluster"):
+        Engine(_mlp(), cluster=object())
+
+
+def test_engine_rejects_tuning():
+    from paddle_tpu.distributed.auto_parallel import Strategy
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+
+    st = Strategy()
+    st.tuning.enable = True
+    eng = Engine(_mlp(), loss=nn.CrossEntropyLoss(), strategy=st)
+    with pytest.raises(NotImplementedError, match="OptimizationTuner"):
+        eng.prepare(mode="train")
+
+
+def test_engine_warns_fused_passes_and_unknown_block():
+    import warnings
+
+    from paddle_tpu.distributed.auto_parallel import Strategy
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        st = Strategy({"no_such_block": {"enable": True}})
+    assert any("no_such_block" in str(w.message) for w in rec)
+
+    st = Strategy()
+    st.fused_passes.enable = True
+    model = _mlp()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    eng = Engine(model, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                 strategy=st)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        eng.prepare(mode="train")
+    assert any("fused_passes" in str(w.message) for w in rec)
+
+
+def test_engine_amp_strategy_trains_bf16():
+    """strategy.amp.enable: the forward traces under autocast — params stay
+    f32, matmuls run bf16, and the loss still descends."""
+    from paddle_tpu.distributed.auto_parallel import Strategy
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+
+    st = Strategy()
+    st.amp.enable = True
+    st.amp.level = "O1"
+    model = _mlp()
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+    eng = Engine(model, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                 strategy=st)
+    hist = eng.fit(_RandomDS(), epochs=3, batch_size=16)
+    assert hist["loss"][-1] < hist["loss"][0], hist
+
+
+def test_engine_gradient_merge_matches_big_batch():
+    """gradient_merge.k_steps=2 over batch 32 takes the same first step as
+    one batch-32 step (averaged accumulation), and trains."""
+    from paddle_tpu.distributed.auto_parallel import Strategy
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+
+    def run(gm):
+        paddle.seed(7)
+        st = Strategy()
+        if gm:
+            st.gradient_merge.enable = True
+            st.gradient_merge.k_steps = 2
+        model = _mlp()
+        opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                     parameters=model.parameters())
+        eng = Engine(model, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                     strategy=st)
+        hist = eng.fit(_RandomDS(), epochs=2, batch_size=32)
+        return hist
+
+    ref = run(False)
+    got = run(True)
+    assert got["loss"][-1] < got["loss"][0]
+    # same data order, averaged grads: trajectories should be close
+    np.testing.assert_allclose(got["loss"][0], ref["loss"][0], rtol=0.05)
+
+
+def test_engine_recompute_strategy():
+    from paddle_tpu.distributed.auto_parallel import Strategy
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+
+    st = Strategy()
+    st.recompute.enable = True
+    model = _mlp()
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+    eng = Engine(model, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                 strategy=st)
+    hist = eng.fit(_RandomDS(), epochs=2, batch_size=16)
+    assert hist["loss"][-1] < hist["loss"][0], hist
+
+
+def test_engine_multi_axis_annotations():
+    """Multi-axis shard_tensor annotations run on the USER's mesh with its
+    own axis names (the r4 single-non-dp-axis limitation, lifted)."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.auto_parallel.static import Engine
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("dp", "x", "y"))
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    # annotate the first weight over BOTH x and y
+    w = model[0].weight
+    w._data = jax.device_put(w._data, NamedSharding(mesh, P("x", "y")))
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+    eng = Engine(model, loss=nn.CrossEntropyLoss(), optimizer=opt)
+    hist = eng.fit(_RandomDS(), epochs=2, batch_size=16)
+    assert hist["loss"][-1] < hist["loss"][0], hist
+    # the engine ran on the user mesh
+    assert eng._engine.mesh.axis_names == ("dp", "x", "y")
+
+
+def test_inference_config_no_silent_knobs():
+    """Every accepted-but-inert Config knob announces itself (no silently
+    ignored knob on either surface — VERDICT r4 item 4)."""
+    import warnings
+
+    from paddle_tpu.inference import Config
+
+    cfg = Config("x")
+    for call, kwargs in [
+        ("enable_memory_optim", {}),
+        ("enable_mkldnn", {}),
+        ("enable_tensorrt_engine", {}),
+        ("enable_profile", {}),
+        ("set_cpu_math_library_num_threads", {"n": 4}),
+    ]:
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            getattr(cfg, call)(**kwargs)
+        assert any("no-op" in str(w.message) for w in rec), call
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        cfg.switch_ir_optim(False)
+    assert any("cannot be disabled" in str(w.message) for w in rec)

@@ -716,3 +716,67 @@ def test_weight_only_int8_bert_predictor(tmp_path):
     assert rel < 0.1, f"int8 BERT relative error {rel:.4f}"
     agree = (got.argmax(-1) == ref.argmax(-1)).mean()
     assert agree > 0.9, f"argmax agreement {agree:.3f}"
+
+
+def test_int8_ptq_predictor(tmp_path):
+    """Activation-int8 PTQ (VERDICT r4 item 3): jit.save(...,
+    quantize='int8_ptq', calib_reader=...) calibrates per-layer input
+    scales with min-max observers, exports int8 x int8 -> int32 matmul/conv
+    math with folded dequant, and the Predictor matches fp within int8
+    error bounds (reference nn/quant/format.py LinearQuanter/Dequanter via
+    analysis-predictor int8 passes)."""
+    import pickle
+
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    class ConvLin(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+            self.act = nn.ReLU()
+            self.fc = nn.Linear(8 * 8 * 8, 32)
+
+        def forward(self, x):
+            h = self.act(self.conv(x))
+            return self.fc(paddle.reshape(h, [h.shape[0], -1]))
+
+    paddle.seed(0)
+    m = ConvLin()
+    rng = np.random.default_rng(0)
+    calib = [rng.normal(size=(4, 3, 8, 8)).astype("float32")
+             for _ in range(4)]
+    x = rng.normal(size=(4, 3, 8, 8)).astype("float32")
+    ref = m(paddle.to_tensor(x)).numpy()
+
+    q8 = str(tmp_path / "ptq8")
+    spec = [InputSpec([None, 3, 8, 8], "float32", "x")]
+    paddle.jit.save(m, q8, input_spec=spec, quantize="int8_ptq",
+                    calib_reader=calib)
+
+    # the patch restored the model: eager forward unchanged after save
+    np.testing.assert_allclose(m(paddle.to_tensor(x)).numpy(), ref,
+                               atol=1e-6)
+
+    with open(q8 + ".pdmodel", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["quantize"] == "int8_ptq"
+    assert set(meta["quantized_keys"]) == {"conv.weight", "fc.weight"}
+    with open(q8 + ".pdiparams", "rb") as f:
+        qstate = pickle.load(f)
+    for k in meta["quantized_keys"]:
+        assert qstate[k].dtype == np.int8
+
+    cfg = Config(q8)
+    cfg.disable_gpu()
+    out = create_predictor(cfg).run([x])[0]
+    # int8 activation+weight error: looser than weight-only but bounded
+    err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert err < 0.1, f"int8_ptq relative error {err:.4f}"
+    # and it is genuinely quantized — not bit-identical to fp
+    assert np.abs(out - ref).max() > 0
+
+    # calib_reader required
+    with pytest.raises(ValueError, match="calib_reader"):
+        paddle.jit.save(m, str(tmp_path / "bad"), input_spec=spec,
+                        quantize="int8_ptq")
