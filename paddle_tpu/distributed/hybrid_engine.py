@@ -156,13 +156,33 @@ class HybridParallelEngine:
     def __init__(self, config, dp=1, pp=1, mp=1, micro_batches=None, sp=False,
                  devices=None, dtype=jnp.float32, remat=True, lr=3e-4,
                  schedule="gpipe", num_virtual_stages=2, zero_stage=1,
-                 loss_chunk=None, moments="f32"):
+                 loss_chunk=None, moments="f32", cp=1, cp_mode="ring"):
         from paddle_tpu.models.llama import LlamaConfig  # noqa: F401 (type)
 
         self.config = config
         self.args = lf.LlamaArgs.from_config(config)
         self.dp, self.pp, self.mp = dp, pp, mp
         self.sp = sp and mp > 1
+        # CP: context parallelism as a 4th mesh axis — sequences arrive
+        # seq-sharded over 'cp'; attention runs ring_attention (kv ring)
+        # or ulysses (all_to_all) per layer (SURVEY §5 long context; the
+        # reference snapshot has neither)
+        if cp_mode not in ("ring", "ulysses"):
+            raise ValueError("cp_mode must be 'ring' or 'ulysses'")
+        self.cp, self.cp_mode = cp, cp_mode
+        self._cp_axis = "cp" if cp > 1 else None
+        # cp-derived pieces shared by all four schedule paths
+        self._cp_vary = ("cp",) if cp > 1 else ()
+        self._loss_axes = ("dp", "cp") if cp > 1 else "dp"
+        self._data_spec = P(None, "dp", "cp" if cp > 1 else None)
+        if cp > 1 and cp_mode == "ulysses":
+            local_heads = self.args.num_heads // max(mp, 1)
+            local_kv = max(1, self.args.num_kv_heads // max(mp, 1))
+            if local_heads % cp != 0 or local_kv % cp != 0:
+                raise ValueError(
+                    f"cp_mode='ulysses' needs local q heads ({local_heads}) "
+                    f"AND kv heads ({local_kv}) divisible by cp={cp}; use "
+                    "cp_mode='ring'")
         self.micro_batches = micro_batches or max(pp, 1)
         self.dtype = dtype
         self.remat = remat
@@ -192,9 +212,19 @@ class HybridParallelEngine:
         # _build_param_specs: leaves whose first param axis doesn't divide
         # dp (x mp) stay moment-sharded only, with a warning — a graceful
         # fallback instead of r2's hard rejection (VERDICT item 10)
-        if schedule not in ("gpipe", "1f1b", "interleave", "zb"):
+        if schedule not in ("gpipe", "1f1b", "interleave", "zb", "auto"):
             raise ValueError(f"unknown pipeline schedule {schedule!r} "
-                             "(gpipe | 1f1b | interleave | zb)")
+                             "(gpipe | 1f1b | interleave | zb | auto)")
+        if schedule == "auto":
+            # cost model (validated by the dryrun's repeated-median sweep):
+            # both run M+2S-1 ticks; 1f1b's tick is F + full backward (~3F),
+            # zb's is F + activation-grad (~2F) plus a deferred weight-grad
+            # phase ~M unit-backwards => zb wins iff M < 2S-1 — the
+            # fill/drain-dominated deep-pipeline regime zero-bubble targets
+            # (reference pipeline_zero_bubble.py:62 schedules it
+            # unconditionally; we pick by regime)
+            M = self.micro_batches
+            schedule = "zb" if pp > 1 and M < 2 * pp - 1 else "1f1b"
         self.schedule = schedule if pp > 1 else "gpipe"
         self.num_virtual_stages = num_virtual_stages
         if self.schedule == "interleave":
@@ -214,11 +244,11 @@ class HybridParallelEngine:
             raise ValueError("num_attention_heads must divide mp")
 
         devices = devices if devices is not None else jax.devices()
-        n = dp * pp * mp
+        n = dp * pp * mp * cp
         if len(devices) < n:
             raise ValueError(f"need {n} devices, have {len(devices)}")
-        dev_array = np.asarray(devices[:n]).reshape(dp, pp, mp)
-        self.mesh = Mesh(dev_array, ("dp", "pp", "mp"))
+        dev_array = np.asarray(devices[:n]).reshape(dp, pp, mp, cp)
+        self.mesh = Mesh(dev_array, ("dp", "pp", "mp", "cp"))
 
         self._zero_skip = frozenset()  # zero-3 leaves left unsharded
         self._param_specs = self._build_param_specs()
@@ -389,6 +419,19 @@ class HybridParallelEngine:
                          is_leaf=lambda x: isinstance(x, P)))
         return tdef.unflatten(flat_specs)
 
+
+    def _rope_local(self, s_len):
+        """RoPE tables for THIS device's seq chunk: under cp the position
+        ids are global (chunk r covers [r*s_local, (r+1)*s_local))."""
+        hd = self.args.hidden_size // self.args.num_heads
+        if self.cp == 1:
+            return lf.rope_tables(s_len, hd, self.args.rope_theta)
+        cos, sin = lf.rope_tables(s_len * self.cp, hd, self.args.rope_theta)
+        r = jax.lax.axis_index("cp")
+        cos = jax.lax.dynamic_slice_in_dim(cos, r * s_len, s_len, axis=0)
+        sin = jax.lax.dynamic_slice_in_dim(sin, r * s_len, s_len, axis=0)
+        return cos, sin
+
     # -- the pipelined local step (runs inside shard_map) --------------------
     def _mk_stage_helpers(self, ids, labels, s_len):
         """The per-stage pieces every schedule shares, parameterized on the
@@ -431,8 +474,7 @@ class HybridParallelEngine:
         mp, sp = self.mp, self.sp
         stage = jax.lax.axis_index("pp")
         s_len = ids.shape[-1]
-        hd = args.hidden_size // args.num_heads
-        cos, sin = lf.rope_tables(s_len, hd, args.rope_theta)
+        cos, sin = self._rope_local(s_len)
 
         # embedding/lm_head/final_norm are replicated over 'pp' but used only
         # inside stage-gated conds. pvary them HERE (outside the conds) so the
@@ -451,7 +493,8 @@ class HybridParallelEngine:
         def stage_fn(h):
             return lf.run_layers(lp["layers"], h, cos, sin, args, mp_axis, mp,
                                  sp, self.remat, zero_axis=za,
-                                 zero_skip=self._zero_skip)
+                                 zero_skip=self._zero_skip,
+                                 cp_axis=self._cp_axis, cp_mode=self.cp_mode)
 
         perm = [(i, i + 1) for i in range(S - 1)]
 
@@ -486,7 +529,8 @@ class HybridParallelEngine:
         # the scan carry becomes device-varying after one step (data over
         # 'dp', stage-gated compute over 'pp', seq shards over 'mp' under
         # SP); pvary the zero carry up-front so the vma type is stable
-        vary_axes = ("dp", "pp") + (("mp",) if (sp and mp_axis) else ())
+        vary_axes = (("dp", "pp") + self._cp_vary
+                     + (("mp",) if (sp and mp_axis) else ()))
         h0 = jax.lax.pcast(h0, vary_axes, to="varying")
         _, losses = jax.lax.scan(step, h0, jnp.arange(M + S - 1))
         # Scale by 1/dp so this is each rank's *contribution to the global
@@ -495,7 +539,7 @@ class HybridParallelEngine:
         # grads across dp ranks (the reference's EagerReducer allreduce,
         # reducer.cc:1089); with the 1/dp here that sum is the global-mean
         # gradient, no post-hoc pmean (which would double-scale) needed.
-        total = jnp.sum(losses) / (M * self.dp)
+        total = jnp.sum(losses) / (M * self.dp * self.cp)
         # stage-gated cond makes the loss pp-varying even at pp=1; psum
         # collapses it (only the last stage contributed non-zeros)
         total = jax.lax.psum(total, "pp")
@@ -521,8 +565,7 @@ class HybridParallelEngine:
         mp, sp = self.mp, self.sp
         stage = jax.lax.axis_index("pp")
         s_len = ids.shape[-1]
-        hd = args.hidden_size // args.num_heads
-        cos, sin = lf.rope_tables(s_len, hd, args.rope_theta)
+        cos, sin = self._rope_local(s_len)
         lc = args.num_layers // (S * V)  # layers per chunk
 
         lp = dict(lp)
@@ -537,7 +580,8 @@ class HybridParallelEngine:
                 lp["layers"])
             return lf.run_layers(chunk, h, cos, sin, args, mp_axis, mp, sp,
                                  self.remat, zero_axis=za,
-                                 zero_skip=self._zero_skip)
+                                 zero_skip=self._zero_skip,
+                                 cp_axis=self._cp_axis, cp_mode=self.cp_mode)
 
         embed_mb, head_loss, zero_loss = self._mk_stage_helpers(
             ids, labels, s_len)
@@ -568,13 +612,14 @@ class HybridParallelEngine:
         mb_local = ids.shape[1]
         seq_local = s_len // mp if (sp and mp_axis) else s_len
         h0 = jnp.zeros((mb_local, seq_local, args.hidden_size), self.dtype)
-        vary_axes = ("dp", "pp") + (("mp",) if (sp and mp_axis) else ())
+        vary_axes = (("dp", "pp") + self._cp_vary
+                     + (("mp",) if (sp and mp_axis) else ()))
         h0 = jax.lax.pcast(h0, vary_axes, to="varying")
         G = -(-M // S)  # groups of S micro-batches
         a_max = (G - 1) * S * V + (V - 1) * S + (M - 1) % S
         T = a_max + S  # last unit finishes at stage S-1, tick a_max + S - 1
         _, losses = jax.lax.scan(step, h0, jnp.arange(T))
-        total = jnp.sum(losses) / (M * self.dp)
+        total = jnp.sum(losses) / (M * self.dp * self.cp)
         total = jax.lax.psum(total, "pp")
         return total
 
@@ -593,7 +638,8 @@ class HybridParallelEngine:
                 present.update(ax)
             elif ax is not None:
                 present.add(ax)
-        return tuple(ax for ax in ("dp", "pp") if ax not in present)
+        cands = ("dp", "pp") + self._cp_vary
+        return tuple(ax for ax in cands if ax not in present)
 
     def _grads_1f1b(self, lp, ids, labels):
         """Per-device 1F1B loss+grads. Unlike the GPipe path (AD over the
@@ -615,8 +661,7 @@ class HybridParallelEngine:
         mp, sp = self.mp, self.sp
         stage = jax.lax.axis_index("pp")
         s_len = ids.shape[-1]
-        hd = args.hidden_size // args.num_heads
-        cos, sin = lf.rope_tables(s_len, hd, args.rope_theta)
+        cos, sin = self._rope_local(s_len)
 
         # pvary every param over the mesh axes missing from its spec: the
         # per-micro-batch vjps then stay collective-free on those axes
@@ -634,7 +679,8 @@ class HybridParallelEngine:
         def stage_layers(lp_, h):
             return lf.run_layers(lp_["layers"], h, cos, sin, args, mp_axis,
                                  mp, sp, self.remat, zero_axis=za,
-                                 zero_skip=self._zero_skip)
+                                 zero_skip=self._zero_skip,
+                                 cp_axis=self._cp_axis, cp_mode=self.cp_mode)
 
         embed_mb, head_loss, zero_loss = self._mk_stage_helpers(
             ids, labels, s_len)
@@ -644,7 +690,8 @@ class HybridParallelEngine:
         mb_local = ids.shape[1]
         seq_local = s_len // mp if (sp and mp_axis) else s_len
         h_shape = (mb_local, seq_local, args.hidden_size)
-        vary_axes = ("dp", "pp") + (("mp",) if (sp and mp_axis) else ())
+        vary_axes = (("dp", "pp") + self._cp_vary
+                     + (("mp",) if (sp and mp_axis) else ()))
 
         def vary(x):
             return jax.lax.pcast(x, vary_axes, to="varying")
@@ -713,15 +760,16 @@ class HybridParallelEngine:
         g0 = vary(jnp.zeros(h_shape, self.dtype))
         slots0 = vary(jnp.zeros((B + 1,) + h_shape, self.dtype))
         gacc0 = jax.tree.map(jnp.zeros_like, lp)
-        lacc0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("dp", "pp"),
+        lacc0 = jax.lax.pcast(jnp.zeros((), jnp.float32),
+                              ("dp", "pp") + self._cp_vary,
                               to="varying")
         T = M + 2 * S - 1
         (_, _, _, gacc, lacc), _ = jax.lax.scan(
             step, (h0, g0, slots0, gacc0, lacc0), jnp.arange(T))
 
-        c = 1.0 / (M * self.dp)
+        c = 1.0 / (M * self.dp * self.cp)
         loss = jax.lax.psum(lacc, "pp") * c
-        loss = jax.lax.psum(loss, "dp")
+        loss = jax.lax.psum(loss, self._loss_axes)
         grads = jax.tree.map(
             lambda g, sp_: jax.lax.psum(
                 (g.astype(jnp.float32) * c).astype(g.dtype),
@@ -761,8 +809,7 @@ class HybridParallelEngine:
         mp, sp = self.mp, self.sp
         stage = jax.lax.axis_index("pp")
         s_len = ids.shape[-1]
-        hd = args.hidden_size // args.num_heads
-        cos, sin = lf.rope_tables(s_len, hd, args.rope_theta)
+        cos, sin = self._rope_local(s_len)
 
         spec_tree = self._spec_tree(lp)
         lp = jax.tree.map(
@@ -775,7 +822,8 @@ class HybridParallelEngine:
         def stage_layers(lp_, h):
             return lf.run_layers(lp_["layers"], h, cos, sin, args, mp_axis,
                                  mp, sp, self.remat, zero_axis=za,
-                                 zero_skip=self._zero_skip)
+                                 zero_skip=self._zero_skip,
+                                 cp_axis=self._cp_axis, cp_mode=self.cp_mode)
 
         embed_mb, head_loss, zero_loss = self._mk_stage_helpers(
             ids, labels, s_len)
@@ -784,7 +832,8 @@ class HybridParallelEngine:
         mb_local = ids.shape[1]
         seq_local = s_len // mp if (sp and mp_axis) else s_len
         h_shape = (mb_local, seq_local, args.hidden_size)
-        vary_axes = ("dp", "pp") + (("mp",) if (sp and mp_axis) else ())
+        vary_axes = (("dp", "pp") + self._cp_vary
+                     + (("mp",) if (sp and mp_axis) else ()))
 
         def vary(x):
             return jax.lax.pcast(x, vary_axes, to="varying")
@@ -852,7 +901,8 @@ class HybridParallelEngine:
         g0 = vary(jnp.zeros(h_shape, self.dtype))
         h_store0 = vary(jnp.zeros((M + 1,) + h_shape, self.dtype))
         g_store0 = vary(jnp.zeros((M + 1,) + h_shape, self.dtype))
-        lacc0 = jax.lax.pcast(jnp.zeros((), jnp.float32), ("dp", "pp"),
+        lacc0 = jax.lax.pcast(jnp.zeros((), jnp.float32),
+                              ("dp", "pp") + self._cp_vary,
                               to="varying")
         T = M + 2 * S - 1
         (_, _, h_store, g_store, lacc), _ = jax.lax.scan(
@@ -898,9 +948,9 @@ class HybridParallelEngine:
             w_step, gacc0,
             (h_store[:M], g_store[:M], jnp.arange(M)))
 
-        c = 1.0 / (M * self.dp)
+        c = 1.0 / (M * self.dp * self.cp)
         loss = jax.lax.psum(lacc, "pp") * c
-        loss = jax.lax.psum(loss, "dp")
+        loss = jax.lax.psum(loss, self._loss_axes)
         grads = jax.tree.map(
             lambda g, sp_: jax.lax.psum(
                 (g.astype(jnp.float32) * c).astype(g.dtype),
@@ -957,7 +1007,7 @@ class HybridParallelEngine:
                    else self._pipeline_loss)
         loss, grads = jax.value_and_grad(loss_fn)(lp, ids, labels)
         # loss is this rank's 1/dp-scaled contribution: psum = global mean
-        loss = jax.lax.psum(loss, "dp")
+        loss = jax.lax.psum(loss, self._loss_axes)
         return loss, grads
 
     # -- public API ----------------------------------------------------------
@@ -966,11 +1016,11 @@ class HybridParallelEngine:
             return self._train_step
         mesh = self.mesh
         param_specs = self._param_specs
-        data_spec = P(None, "dp", None)  # [M, batch, seq]
+        data_spec = self._data_spec  # [M, batch, seq]
 
         flat_specs_tree = param_specs
 
-        if self.dp == self.pp == self.mp == 1:
+        if self.dp == self.pp == self.mp == 1 and self.cp == 1:
             # degenerate mesh: the fast path IS the reference program
             shard_mapped = self._grads_trivial
         else:
@@ -1016,7 +1066,7 @@ class HybridParallelEngine:
                     and a.shape[0] == M)
 
         if placed(ids) and placed(labels):
-            expect = self._sharding(P(None, "dp", None))
+            expect = self._sharding(self._data_spec)
             for name, a in (("ids", ids), ("labels", labels)):
                 if a.shape[1] % self.dp != 0:
                     raise ValueError(
@@ -1031,9 +1081,12 @@ class HybridParallelEngine:
         B = ids.shape[0]
         if B % (M * self.dp) != 0:
             raise ValueError(f"batch {B} must divide micro_batches*dp={M * self.dp}")
+        if ids.shape[-1] % self.cp != 0:
+            raise ValueError(f"seq len {ids.shape[-1]} must divide "
+                             f"cp={self.cp}")
         ids = np.asarray(ids).reshape(M, B // M, -1)
         labels = np.asarray(labels).reshape(M, B // M, -1)
-        sharding = self._sharding(P(None, "dp", None))
+        sharding = self._sharding(self._data_spec)
         return (jax.device_put(ids, sharding), jax.device_put(labels, sharding))
 
     def train_batch(self, params, opt_state, ids, labels):

@@ -29,6 +29,7 @@ module is for the sparse pull/push pattern.
 
 from __future__ import annotations
 
+import json
 import os
 import threading
 
@@ -92,6 +93,8 @@ class SparseTable:
     def __init__(self, dim, initializer="uniform", init_scale=0.01, lr=0.05,
                  seed=0, optimizer="sgd", show_decay=0.98, **opt_kwargs):
         self.dim = dim
+        self.seed = seed
+        self.opt_kwargs = dict(opt_kwargs)
         self.lr = lr
         self.init_scale = init_scale
         self.initializer = initializer
@@ -190,7 +193,14 @@ class SparseTable:
                                np.float32)
                     if keys.size else np.zeros((0, 2), np.float32))
             st = {"keys": keys, "rows": rows, "meta": meta,
-                  "optimizer": self.optimizer}
+                  "optimizer": self.optimizer,
+                  # construction params so a crash-restarted server can
+                  # re-CREATE the table from its saved state alone
+                  "config": np.asarray([self.dim, self.lr, self.init_scale,
+                                        self.show_decay, self.seed],
+                                       np.float64),
+                  "initializer": self.initializer,
+                  "opt_kwargs": json.dumps(self.opt_kwargs)}
             # optimizer slot state rides along (adagrad G2Sum / adam
             # moments+step); dropping it would make the first post-restore
             # adam push take a full-lr bias-corrected jump
@@ -258,7 +268,8 @@ class DenseTable:
 
     def state(self):
         with self._lock:
-            return {"value": self.value.copy()}
+            return {"value": self.value.copy(),
+                    "lr": np.float64(self.lr)}
 
     def load_state(self, st):
         with self._lock:
@@ -281,12 +292,40 @@ def _srv_pull_sparse(name, ids, clicks=None, record_show=True):
     return _tables[name].pull(ids, clicks, record_show)
 
 
-def _srv_apply_delta(name, ids, deltas):
+def _srv_apply_delta(name, ids, deltas, req_id=None):
+    if _seen_req(req_id):
+        return True
     _tables[name].apply_delta(ids, deltas)
     return True
 
 
-def _srv_push_sparse(name, ids, grads, lr=None):
+# at-least-once rpc retries must not double-apply mutations (the reply,
+# not the request, may be what a transient failure lost): mutating server
+# calls carry a request id and repeats are dropped (the reference brpc
+# service's request dedup)
+import collections as _collections
+
+_applied_reqs = set()
+_applied_order = _collections.deque()
+_req_lock = threading.Lock()
+
+
+def _seen_req(req_id):
+    if req_id is None:
+        return False
+    with _req_lock:
+        if req_id in _applied_reqs:
+            return True
+        _applied_reqs.add(req_id)
+        _applied_order.append(req_id)
+        if len(_applied_order) > 8192:
+            _applied_reqs.discard(_applied_order.popleft())
+        return False
+
+
+def _srv_push_sparse(name, ids, grads, lr=None, req_id=None):
+    if _seen_req(req_id):
+        return True
     _tables[name].push(ids, grads, lr)
     return True
 
@@ -295,7 +334,9 @@ def _srv_pull_dense(name):
     return _tables[name].pull()
 
 
-def _srv_push_dense(name, grad, lr=None):
+def _srv_push_dense(name, grad, lr=None, req_id=None):
+    if _seen_req(req_id):
+        return True
     _tables[name].push(grad, lr)
     return True
 
@@ -308,7 +349,35 @@ def _srv_state(name):
     return _tables[name].state()
 
 
+def _unstr(x, default=""):
+    if x is None:
+        return default
+    x = np.asarray(x)
+    return str(x.item()) if x.ndim == 0 else str(x)
+
+
 def _srv_load_state(name, st):
+    if name not in _tables:
+        # crash-restarted server: re-create the table from the saved
+        # construction params (reference PServer load creates tables from
+        # the table proto before filling rows)
+        if "value" in st:
+            val = np.asarray(st["value"])
+            t = DenseTable(val.shape,
+                           lr=float(np.asarray(st.get("lr", 0.05))))
+        else:
+            cfg = np.asarray(st.get("config",
+                                    [np.asarray(st["rows"]).shape[-1],
+                                     0.05, 0.01, 0.98, 0]),
+                             np.float64).ravel()
+            okw = json.loads(_unstr(st.get("opt_kwargs"), "{}") or "{}")
+            t = SparseTable(
+                dim=int(cfg[0]), lr=float(cfg[1]), init_scale=float(cfg[2]),
+                show_decay=float(cfg[3]),
+                seed=int(cfg[4]) if cfg.size > 4 else 0,
+                initializer=_unstr(st.get("initializer"), "uniform"),
+                optimizer=_unstr(st.get("optimizer"), "sgd"), **okw)
+        _tables[name] = t
     _tables[name].load_state(st)
     return True
 
@@ -326,12 +395,34 @@ def _srv_shutdown():
     return True
 
 
+# how long a trainer keeps retrying a dead server shard before giving up
+# (the reference communicator's send-retry window); the supervisor is
+# expected to restart the server within it
+_FAILOVER_TIMEOUT_S = float(os.environ.get("FLAGS_ps_failover_timeout", 60))
+
+
 def _call_on(worker, fn, *args, **kwargs):
     if worker is None:
         return fn(*args, **kwargs)
+    import time
+
     from paddle_tpu.distributed import rpc
 
-    return rpc.rpc_sync(worker, fn, args=args, kwargs=kwargs)
+    deadline = time.time() + _FAILOVER_TIMEOUT_S
+    while True:
+        try:
+            return rpc.rpc_sync(worker, fn, args=args, kwargs=kwargs)
+        except (ConnectionError, EOFError, OSError):
+            # server shard down: keep retrying against the (possibly
+            # re-published) endpoint until the supervisor restarts it —
+            # PS failover (reference ps/service heartbeat + reconnect)
+            if time.time() > deadline:
+                raise
+            time.sleep(0.5)
+            try:
+                rpc.refresh_worker(worker, timeout=5.0)
+            except Exception:
+                pass
 
 
 def _shard_of(key):
@@ -367,15 +458,24 @@ def _fanout(srv_fn, name, ids, row_extras=(), extra_args=(), gather=True):
     for w, idxs in parts.items():
         sliced = [None if e is None else np.asarray(e)[idxs]
                   for e in row_extras]
-        futs.append((idxs, _rpc.rpc_async(
+        futs.append((w, idxs, sliced, _rpc.rpc_async(
             w, srv_fn, args=(name, flat[idxs], *sliced, *extra_args))))
+
+    def result(w, idxs, sliced, f):
+        try:
+            return f.wait()
+        except (ConnectionError, EOFError, OSError):
+            # shard died mid-flight: _call_on retries with failover
+            return _call_on(w, srv_fn, name, flat[idxs], *sliced,
+                            *extra_args)
+
     if not gather:
-        for _, f in futs:
-            f.wait()
+        for w, idxs, sliced, f in futs:
+            result(w, idxs, sliced, f)
         return True
     rows = [None] * flat.size
-    for idxs, f in futs:
-        got = f.wait()
+    for w, idxs, sliced, f in futs:
+        got = result(w, idxs, sliced, f)
         for j, i in enumerate(idxs):
             rows[i] = got[j]
     return np.stack(rows)
@@ -433,9 +533,11 @@ def pull_sparse(name, ids, clicks=None):
 
 def push_sparse(name, ids, grads, lr=None):
     """Apply the table's sparse optimizer on the server rows."""
+    import uuid
+
     return _fanout(_srv_push_sparse, name, ids,
                    row_extras=(np.asarray(grads, np.float32),),
-                   extra_args=(lr,), gather=False)
+                   extra_args=(lr, uuid.uuid4().hex), gather=False)
 
 
 def pull_dense(name):
@@ -444,9 +546,11 @@ def pull_dense(name):
 
 
 def push_dense(name, grad, lr=None):
+    import uuid
+
     w = _server_workers[0] if _server_workers else None
     return _call_on(w, _srv_push_dense, name,
-                    np.asarray(grad, np.float32), lr)
+                    np.asarray(grad, np.float32), lr, uuid.uuid4().hex)
 
 
 def shrink(name, threshold=1.0):
@@ -480,48 +584,80 @@ def load_tables(path, names=None):
     re-sharded by the CURRENT hash routing (the reference's load with
     changed pserver count re-distributes rows the same way)."""
     workers = _server_workers or [None]
-    if names is None:
-        names = sorted({f.split(".shard")[0] for f in os.listdir(path)
-                        if ".shard" in f})
-    for name in names:
-        shard_files = sorted(
-            f for f in os.listdir(path)
-            if f.startswith(name + ".shard") and f.endswith(".npz"))
-        if not shard_files:
-            raise FileNotFoundError(f"no shards for table {name} in {path}")
-        states = [dict(np.load(os.path.join(path, f))) for f in shard_files]
-        if "value" in states[0]:  # dense table: single logical state
-            _call_on(workers[0], _srv_load_state, name, states[0])
+    for name, merged in _shard_states_from_dir(path, names).items():
+        if "value" in merged:  # dense table: single logical state
+            _call_on(workers[0], _srv_load_state, name, merged)
             continue
-        merged = _merge_sparse_states(states)
         if len(workers) == 1:
             _call_on(workers[0], _srv_load_state, name, merged)
             continue
         for wi, w in enumerate(workers):
-            sel = np.asarray([i for i, k in enumerate(merged["keys"])
-                              if int(k) % len(workers) == wi], np.int64)
             _call_on(w, _srv_load_state, name,
-                     {k2: v[sel] for k2, v in merged.items()
-                      if isinstance(v, np.ndarray)}
-                     | {"optimizer": merged.get("optimizer", "sgd")})
+                     _route_shard(merged, wi, len(workers)))
+
+
+def _shard_states_from_dir(path, names=None):
+    """{table: merged logical state} from a save_tables dir — THE single
+    reader for every load path (trainer reshard-load, rejoined-server
+    local load, targeted reload)."""
+    if names is None:
+        names = sorted({f.split(".shard")[0] for f in os.listdir(path)
+                        if ".shard" in f})
+    out = {}
+    for tname in names:
+        shard_files = sorted(
+            f for f in os.listdir(path)
+            if f.startswith(tname + ".shard") and f.endswith(".npz"))
+        if not shard_files:
+            raise FileNotFoundError(f"no shards for table {tname} in {path}")
+        states = [dict(np.load(os.path.join(path, f))) for f in shard_files]
+        out[tname] = (states[0] if "value" in states[0]
+                      else _merge_sparse_states(states))
+    return out
+
+
+def _route_shard(merged, shard_index, n_shards):
+    """The rows shard `shard_index` owns under the current hash routing."""
+    sel = np.asarray([i for i, k in enumerate(merged["keys"])
+                      if int(k) % n_shards == shard_index], np.int64)
+    return _select_rows(merged, sel)
+
+
+def _select_rows(merged, sel):
+    """Row-subset of a merged sparse state; per-table metadata
+    (optimizer/config/initializer) passes through un-sliced."""
+    meta = ("optimizer", "config", "initializer", "opt_kwargs", "lr")
+    out = {k: v[sel] for k, v in merged.items()
+           if isinstance(v, np.ndarray) and k not in meta}
+    for k in meta:
+        if k in merged:
+            out[k] = merged[k]
+    return out
 
 
 def _merge_sparse_states(states):
-    """Concatenate per-shard sparse states into one logical table state."""
+    """Concatenate per-shard sparse states into one logical table state
+    (per-table metadata — optimizer/config/initializer — passes through
+    from shard 0, it is identical on every shard)."""
     out = {}
     arr_keys = [k for k in states[0] if isinstance(states[0][k], np.ndarray)
-                and states[0][k].ndim >= 1]
+                and states[0][k].ndim >= 1 and k not in ("config",)]
     for k in arr_keys:
         out[k] = np.concatenate([st[k] for st in states])
     opt = states[0].get("optimizer", "sgd")
     out["optimizer"] = (opt.item() if hasattr(opt, "item") else opt)
+    for meta_k in ("config", "initializer", "opt_kwargs"):
+        if meta_k in states[0]:
+            out[meta_k] = states[0][meta_k]
     return out
 
 
 def _geo_apply_delta(name, ids, deltas):
+    import uuid
+
     return _fanout(_srv_apply_delta, name, ids,
                    row_extras=(np.asarray(deltas, np.float32),),
-                   gather=False)
+                   extra_args=(uuid.uuid4().hex,), gather=False)
 
 
 def _pull_no_show(name, ids):

@@ -1,0 +1,81 @@
+"""PS server-process lifecycle (reference `ps/service/`: the brpc PServer
+runs as its own process with start/stop/load RPCs; `ps/service/server.cc`
+StartServer/StopServer).
+
+TPU-native scope: a PS server here is an rpc worker process whose only job
+is hosting table shards. This module gives it a lifecycle — serve until a
+`stop_serving` rpc arrives, rejoin the rpc world after a crash-restart
+(fresh port, same rank), and reload its shard from a `save_tables` dir —
+plus the trainer-side helpers. Worker pull/push failover (retry + endpoint
+refresh) lives in `_call_on`/`_fanout`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from paddle_tpu.distributed import ps
+
+__all__ = ["serve", "stop_serving", "reload_shard"]
+
+_stop = threading.Event()
+
+
+def _srv_stop_serving():
+    _stop.set()
+    return True
+
+
+def serve(name, rank, world_size, master_endpoint=None, rejoin=False,
+          load_path=None, shard_index=None, n_shards=None):
+    """Run THIS process as a PS server until a stop_serving() rpc arrives.
+
+    rejoin=True (crash-restart): re-publish this rank's endpoint without
+    the init barrier. load_path: reload this server's rows from a
+    save_tables dir — with shard_index/n_shards the merged save is
+    filtered to the keys this shard owns under the current hash routing
+    (the reference's PServer load RPC)."""
+    from paddle_tpu.distributed import rpc
+
+    # load BEFORE the endpoint goes live: a retrying trainer must never
+    # reach a rejoined server whose tables aren't there yet
+    if load_path is not None:
+        _load_local_shard(load_path, shard_index, n_shards)
+    rpc.init_rpc(name, rank=rank, world_size=world_size,
+                 master_endpoint=master_endpoint, rejoin=rejoin)
+    _stop.wait()
+    rpc.shutdown()
+
+
+def _load_local_shard(path, shard_index, n_shards):
+    """Load THIS process's shard of every saved table directly into the
+    local registry (no rpc — we ARE the server)."""
+    for tname, merged in ps._shard_states_from_dir(path).items():
+        if "value" in merged:  # dense: shard 0 only
+            if not shard_index:
+                ps._srv_load_state(tname, merged)
+            continue
+        if shard_index is not None and n_shards and n_shards > 1:
+            merged = ps._route_shard(merged, shard_index, n_shards)
+        ps._srv_load_state(tname, merged)
+
+
+def stop_serving(worker):
+    """Trainer-side: release a server process from serve()."""
+    return ps._call_on(worker, _srv_stop_serving)
+
+
+def reload_shard(path, worker, shard_index, n_shards, names=None):
+    """Trainer-side targeted reload: push the rows shard `shard_index`
+    owns (under the current routing) from a save_tables dir to `worker` —
+    the recovery half of failover when the restarted server was started
+    without load_path."""
+    for tname, merged in ps._shard_states_from_dir(path, names).items():
+        if "value" in merged:
+            if shard_index == 0:
+                ps._call_on(worker, ps._srv_load_state, tname, merged)
+            continue
+        ps._call_on(worker, ps._srv_load_state, tname,
+                    ps._route_shard(merged, shard_index, n_shards))

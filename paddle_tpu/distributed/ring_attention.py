@@ -138,8 +138,11 @@ def ring_attention(q, k, v, axis_name, causal=True, sm_scale=None,
         return (kk, vv, out, lse), None
 
     out0 = jnp.zeros_like(q)  # inherits q's cp-varying type
-    lse0 = jax.lax.pcast(jnp.full((b, h, s_local), -1e30, jnp.float32),
-                         (axis_name,), to="varying")
+    # tie lse0 to q's FULL vma set (inside a hybrid mesh q may vary over
+    # dp/pp too, not just the ring axis — a hard-coded pcast under-types
+    # the scan carry)
+    tie0 = jnp.sum(q).astype(jnp.float32) * 0
+    lse0 = jnp.full((b, h, s_local), -1e30, jnp.float32) + tie0
     (_, _, out, _), _ = jax.lax.scan(step, (k, v, out0, lse0),
                                      jnp.arange(P))
     return out

@@ -83,9 +83,15 @@ def _handle(conn):
         conn.close()
 
 
-def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None,
+             rejoin=False):
     """Start this worker's rpc agent and rendezvous with the others
-    (reference rpc.py:85)."""
+    (reference rpc.py:85).
+
+    rejoin=True: this process REPLACES a dead worker of the same rank (PS
+    server failover): it re-publishes its rank's endpoint with the fresh
+    port and skips the one-time init barrier (the surviving workers are
+    long past it). Peers pick the new endpoint up via refresh_worker()."""
     global _state
     if _state is not None:
         raise RuntimeError("rpc already initialized")
@@ -144,9 +150,26 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         for r in range(world_size):
             info = pickle.loads(store.get(f"rpc/worker/{r}", timeout=60.0))
             st.workers[info.name] = info
-        store.barrier("rpc/init", rank=rank, world_size=world_size)
+        if not rejoin:
+            store.barrier("rpc/init", rank=rank, world_size=world_size)
     _state = st
     return st
+
+
+def refresh_worker(name, timeout=60.0):
+    """Re-resolve a worker's endpoint from the store: a worker that died
+    and was restarted (init_rpc(rejoin=True)) re-published its rank key
+    with a fresh port; callers retrying a failed rpc refresh first."""
+    if _state is None or _state.store is None:
+        raise RuntimeError("refresh_worker needs an initialized multi-"
+                           "process rpc")
+    info = _state.workers.get(name)
+    if info is None:
+        raise ValueError(f"unknown rpc worker {name!r}")
+    new = pickle.loads(_state.store.get(f"rpc/worker/{info.rank}",
+                                        timeout=timeout))
+    _state.workers[new.name] = new
+    return new
 
 
 def _call_remote(info, fn, args, kwargs, timeout):

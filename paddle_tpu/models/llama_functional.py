@@ -139,10 +139,17 @@ def _attention(q, k, v, use_flash):
 
 
 def decoder_layer(p, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
-                  sp=False):
+                  sp=False, cp_axis=None, cp_mode="ring"):
     """One decoder block. Under shard_map (mp_axis set) the weights held by
     this device are the mp-shards: wq/wk/wv/w_gate/w_up sharded on the out
-    dim, wo/w_down on the in dim; heads are local heads."""
+    dim, wo/w_down on the in dim; heads are local heads.
+
+    cp_axis: context parallelism — h arrives SEQUENCE-sharded over this
+    mesh axis (the caller slices RoPE tables to the local chunk); attention
+    runs ring_attention (kv rotating over the cp ring) or ulysses
+    (all_to_all seq<->head reshard) instead of the local kernel. MLP and
+    norms are per-token, so they need no cp collective at all — long
+    context costs exactly one attention exchange per layer."""
     nh = args.num_heads // (mp_degree if mp_axis else 1)
     nkv = max(1, args.num_kv_heads // (mp_degree if mp_axis else 1))
     hd = args.hidden_size // args.num_heads
@@ -174,7 +181,15 @@ def decoder_layer(p, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
     v = (hin @ p["wv"]).reshape(b, s, nkv, hd)
     cos_t, sin_t = cos[:s], sin[:s]
     q, k = apply_rope(q, k, cos_t, sin_t)
-    attn = _attention(q, k, v, args.use_flash)
+    if cp_axis is not None:
+        from paddle_tpu.distributed.ring_attention import (ring_attention,
+                                                           ulysses_attention)
+
+        attn_fn = (ring_attention if cp_mode == "ring"
+                   else ulysses_attention)
+        attn = attn_fn(q, k, v, axis_name=cp_axis, causal=True)
+    else:
+        attn = _attention(q, k, v, args.use_flash)
     attn = attn.reshape(b, s, nh * hd)
     h = h + reduce_out(attn @ p["wo"])
 
@@ -187,7 +202,8 @@ def decoder_layer(p, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
 
 
 def run_layers(stack, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
-               sp=False, remat=True, zero_axis=None, zero_skip=()):
+               sp=False, remat=True, zero_axis=None, zero_skip=(),
+               cp_axis=None, cp_mode="ring"):
     """lax.scan over stacked layer params (leading dim = layers).
 
     remat: True/'full' (recompute everything — min memory), 'half'
@@ -206,7 +222,8 @@ def run_layers(stack, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
     param axis did not divide the shard degree — the engine's per-leaf
     fallback) and therefore must not be gathered."""
     base_body = functools.partial(decoder_layer, args=args, mp_axis=mp_axis,
-                                  mp_degree=mp_degree, sp=sp)
+                                  mp_degree=mp_degree, sp=sp,
+                                  cp_axis=cp_axis, cp_mode=cp_mode)
     if zero_axis is None:
         body = base_body
     else:

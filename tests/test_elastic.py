@@ -128,3 +128,128 @@ def test_supervisor_gives_up_after_max_restarts():
                                 log=lambda *_: None)
         assert sup.run() == 1
         assert sup.restarts == 3  # 2 allowed + the one that gave up
+
+
+# -- r5: restart-with-reshard E2E (VERDICT r4 item 9) ------------------------
+
+ELASTIC_TRAINER = '''
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+td = os.environ["EL_TMPDIR"]
+if world > 1:
+    import paddle_tpu.distributed as dist
+    dist.init_parallel_env()
+
+from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.distributed.hybrid_engine import HybridParallelEngine
+from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                               save_state_dict)
+
+cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=64,
+                       intermediate_size=128, num_attention_heads=4,
+                       vocab_size=128, max_position_embeddings=64)
+dp = len(jax.devices())  # 4 at world=2, 2 after scale-in to world=1
+eng = HybridParallelEngine(cfg, dp=dp, pp=1, mp=1, micro_batches=1, lr=3e-3)
+params, opt = eng.init_state(0)
+
+latest = os.path.join(td, "latest")
+start = 0
+if os.path.exists(latest):
+    step_dir = open(latest).read().strip()
+    start = int(step_dir.rsplit("step", 1)[1]) + 1
+    state = {"params": params, "opt": opt}
+    load_state_dict(state, step_dir)  # shard-intersection dp4 -> dp2
+    params, opt = state["params"], state["opt"]
+    print(f"RANK{rank} resumed from {step_dir} (dp={dp})", flush=True)
+
+rng = np.random.default_rng(0)
+ids = rng.integers(0, 128, (8, 32)).astype(np.int32)
+labels = rng.integers(0, 128, (8, 32)).astype(np.int32)
+for step in range(start, 12):
+    if world > 1 and step == 6:
+        if rank == 1:
+            print("RANK1 dying uncleanly at step 6", flush=True)
+            os._exit(9)  # the mid-training kill
+        time.sleep(3.0)  # let the heartbeat register the death
+    loss, params, opt = eng.train_batch(params, opt, ids, labels)
+    if rank == 0:
+        with open(os.path.join(td, "loss.log"), "a") as f:
+            f.write(f"{step} {world} {float(loss):.6f}\\n")
+    step_dir = os.path.join(td, f"step{step}")
+    save_state_dict({"params": params, "opt": opt}, step_dir)
+    if rank == 0:
+        with open(latest + ".tmp", "w") as f:
+            f.write(step_dir)
+        os.replace(latest + ".tmp", latest)
+print(f"RANK{rank}_DONE", flush=True)
+'''
+
+ELASTIC_LAUNCHER = '''
+import os, subprocess, sys
+
+world = int(os.environ["EL_NP"])
+td = os.environ["EL_TMPDIR"]
+procs = []
+for r in range(world):
+    env = dict(os.environ)
+    env.update({"PADDLE_TRAINER_ID": str(r),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_HEARTBEAT_INTERVAL": "0.5"})
+    procs.append(subprocess.Popen(
+        [sys.executable, os.path.join(td, "trainer.py")], env=env))
+rcs = [p.wait() for p in procs]
+sys.exit(max(abs(rc) for rc in rcs))
+'''
+
+
+def test_elastic_restart_with_reshard_e2e():
+    """The full fault-tolerance story (VERDICT r4 item 9): rank 1 dies
+    mid-training at world=2 (dp=4); the supervisor restarts at world=1
+    (dp=2); training resumes from the sharded checkpoint via
+    shard-intersection load and the loss keeps descending."""
+    with tempfile.TemporaryDirectory() as td:
+        open(os.path.join(td, "trainer.py"), "w").write(ELASTIC_TRAINER)
+        open(os.path.join(td, "launcher.py"), "w").write(ELASTIC_LAUNCHER)
+        attempts = []
+
+        def env_fn(_manager):
+            # first attempt: 2 nodes; after the failure: scale-in to 1
+            attempts.append(1)
+            np_now = 2 if len(attempts) == 1 else 1
+            env = dict(os.environ)
+            env.update({
+                "EL_NP": str(np_now),
+                "EL_TMPDIR": td,
+                "PADDLE_MASTER": f"127.0.0.1:{_free_port()}",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                "PYTHONUNBUFFERED": "1",
+            })
+            return env
+
+        logs = []
+        sup = ElasticSupervisor(
+            [sys.executable, os.path.join(td, "launcher.py")],
+            env_fn=env_fn, max_restarts=2, log=logs.append)
+        rc = sup.run()
+        assert rc == 0, (rc, logs)
+        assert sup.restarts == 1, (sup.restarts, logs)
+
+        rows = [l.split() for l in open(os.path.join(td, "loss.log"))]
+        losses = {int(s): (int(w), float(v)) for s, w, v in rows}
+        # steps 0..5 ran at world=2, steps 6..11 at world=1
+        assert losses[5][0] == 2 and losses[6][0] == 1, losses
+        assert set(losses) == set(range(12)), sorted(losses)
+        # resumed, not restarted: the post-restart loss continues the
+        # descent instead of jumping back to the init loss
+        assert losses[6][1] < losses[0][1] * 0.98, losses
+        assert losses[11][1] < losses[6][1] < losses[5][1] * 1.05, losses

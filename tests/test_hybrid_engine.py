@@ -712,3 +712,121 @@ def test_lean_moments_on_hybrid_mesh(moments):
     loss, params, opt = eng.train_batch(params, opt, ids, labels)
     loss2, _, _ = eng.train_batch(params, opt, ids, labels)
     assert float(loss2) < float(loss)
+
+
+# -- schedule='auto' (VERDICT r4 item 5) -------------------------------------
+
+
+@pytest.mark.parametrize("pp,M,expect", [
+    (4, 2, "zb"),     # M < 2S-1: fill/drain dominated -> zero bubble
+    (4, 8, "1f1b"),   # M >= 2S-1: steady-state dominated -> 1f1b
+    (2, 2, "zb"),     # 2 < 3
+    (1, 4, "gpipe"),  # no pipeline: degenerate
+])
+def test_schedule_auto_gate(pp, M, expect):
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=1, pp=pp, mp=1, micro_batches=M,
+                               schedule="auto",
+                               devices=jax.devices()[:pp])
+    assert eng.schedule == expect, (pp, M, eng.schedule)
+
+
+def test_schedule_auto_trains():
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=1, pp=4, mp=1, micro_batches=2,
+                               schedule="auto", devices=jax.devices()[:4])
+    assert eng.schedule == "zb"
+    params, opt = eng.init_state(0)
+    ids, labels = _batch(B=4)
+    l1, params, opt = eng.train_batch(params, opt, ids, labels)
+    l2, _, _ = eng.train_batch(params, opt, ids, labels)
+    assert float(l2) < float(l1)
+
+
+# -- CP as a mesh axis (VERDICT r4 item 10) ----------------------------------
+
+
+@pytest.mark.parametrize("cp_mode", ["ring", "ulysses"])
+def test_cp_loss_matches_single_device(cp_mode):
+    """cp=2 seq-sharded training loss matches the single-device loss on the
+    same params/batch (ring kv rotation / ulysses all_to_all inside the
+    full engine step)."""
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=1, pp=1, mp=1, cp=2, cp_mode=cp_mode,
+                               devices=jax.devices()[:2])
+    params, opt = eng.init_state(0)
+    ids, labels = _batch()
+    loss, _, _ = eng.train_batch(params, opt, ids, labels)
+
+    args = lf.LlamaArgs.from_config(cfg)
+    ref_params = lf.init_params(args, jax.random.key(0))
+    ref_loss = lf.forward_and_loss(ref_params, jnp.asarray(ids),
+                                   jnp.asarray(labels), args, remat=False)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-4,
+                               err_msg=cp_mode)
+
+
+@pytest.mark.parametrize("dp,pp,mp,cp,cp_mode", [
+    (2, 2, 1, 2, "ring"),
+    (1, 2, 2, 2, "ulysses"),
+    (2, 1, 2, 2, "ring"),
+])
+def test_cp_inside_full_hybrid(dp, pp, mp, cp, cp_mode):
+    """dp x pp x mp x cp in ONE compiled step: loss parity vs single device
+    + training descends (the VERDICT done-criterion: cp as a first-class
+    mesh axis beside the sep plumbing)."""
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=dp, pp=pp, mp=mp, cp=cp,
+                               cp_mode=cp_mode, micro_batches=2)
+    params, opt = eng.init_state(0)
+    ids, labels = _batch()
+    loss, params, opt = eng.train_batch(params, opt, ids, labels)
+
+    args = lf.LlamaArgs.from_config(cfg)
+    ref_params = lf.init_params(args, jax.random.key(0))
+    ref_loss = lf.forward_and_loss(ref_params, jnp.asarray(ids),
+                                   jnp.asarray(labels), args, remat=False)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=5e-4,
+                               err_msg=f"dp={dp} pp={pp} mp={mp} cp={cp}")
+    loss2, _, _ = eng.train_batch(params, opt, ids, labels)
+    assert float(loss2) < float(loss)
+
+
+def test_cp_grads_match_single_device():
+    """Gradient-tree parity with cp=2: catches wrong loss scaling or a
+    missing cp psum in the vjp."""
+    from jax.sharding import PartitionSpec as P
+
+    cfg = _tiny_cfg()
+    eng = HybridParallelEngine(cfg, dp=2, pp=1, mp=1, cp=2, micro_batches=1,
+                               devices=jax.devices()[:4])
+    params, _ = eng.init_state(0)
+    ids, labels = _batch()
+    i2, l2 = eng.shard_batch(ids, labels)
+    sm = jax.shard_map(
+        eng._local_grads, mesh=eng.mesh,
+        in_specs=(eng._param_specs, P(None, "dp", "cp"),
+                  P(None, "dp", "cp")),
+        out_specs=(P(), eng._param_specs), check_vma=True)
+    _, grads = jax.jit(sm)(params, i2, l2)
+
+    args = lf.LlamaArgs.from_config(cfg)
+    ref_params = lf.init_params(args, jax.random.key(0))
+    _, ref_grads = jax.value_and_grad(lf.forward_and_loss)(
+        ref_params, jnp.asarray(ids), jnp.asarray(labels), args, remat=False)
+    for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
+        rg = ref_grads
+        for pth in path:
+            rg = rg[pth.key]
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(rg), rtol=1e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path))
+
+
+def test_cp_validates_config():
+    cfg = _tiny_cfg()
+    with pytest.raises(ValueError, match="cp_mode"):
+        HybridParallelEngine(cfg, cp=2, cp_mode="nope")
+    with pytest.raises(ValueError, match="ulysses"):
+        # 4 heads / mp=2 = 2 local heads, not divisible by cp=4
+        HybridParallelEngine(cfg, mp=2, cp=4, cp_mode="ulysses")
