@@ -82,7 +82,32 @@ class _AdamRule:
         return row - lr * mhat / (np.sqrt(vhat) + self.eps), [m, v, step]
 
 
-_RULES = {"sgd": _SGDRule, "adagrad": _AdagradRule, "adam": _AdamRule}
+class _FtrlRule:
+    """Per-row FTRL-Proximal (reference ftrl op + the PS sparse FTRL
+    accessor; McMahan et al. 2013 — the classic sparse-CTR optimizer).
+    Slots: z (accumulated adjusted grad), n (accumulated squared grad)."""
+
+    slots = 2
+
+    def __init__(self, l1=0.0, l2=0.0, lr_power=-0.5):
+        self.l1, self.l2, self.lr_power = l1, l2, lr_power
+
+    def update(self, row, slot, g, lr):
+        z, n = slot
+        new_n = n + g * g
+        sigma = (np.power(new_n, -self.lr_power)
+                 - np.power(np.maximum(n, 1e-20), -self.lr_power)) / lr
+        new_z = z + g - sigma * row
+        new_row = np.where(
+            np.abs(new_z) <= self.l1,
+            np.zeros_like(row),
+            (np.sign(new_z) * self.l1 - new_z)
+            / ((np.power(new_n, -self.lr_power)) / lr + 2 * self.l2))
+        return new_row.astype(np.float32), [new_z, new_n]
+
+
+_RULES = {"sgd": _SGDRule, "adagrad": _AdagradRule, "adam": _AdamRule,
+          "ftrl": _FtrlRule}
 
 
 class SparseTable:
@@ -112,6 +137,8 @@ class SparseTable:
             return None
         if isinstance(self.rule, _AdagradRule):
             return [0.0]
+        if isinstance(self.rule, _FtrlRule):
+            return [np.zeros_like(g_like), np.zeros_like(g_like)]
         return [np.zeros_like(g_like), np.zeros_like(g_like), 0]
 
     def _row(self, key):
@@ -221,6 +248,13 @@ class SparseTable:
                 st["slot_step"] = np.asarray(
                     [self._slots.get(int(k), [z, z, 0])[2] for k in keys],
                     np.int64)
+            elif self.optimizer == "ftrl":
+                z = np.zeros(self.dim, np.float32)
+                for si, sk in enumerate(("slot_z", "slot_n")):
+                    st[sk] = (np.stack(
+                        [np.asarray(self._slots.get(int(k), [z, z])[si])
+                         for k in keys]) if keys.size
+                        else np.zeros((0, self.dim), np.float32))
             return st
 
     def load_state(self, st):
@@ -239,6 +273,11 @@ class SparseTable:
                     int(k): [st["slot_m"][i].astype(np.float32),
                              st["slot_v"][i].astype(np.float32),
                              int(st["slot_step"][i])]
+                    for i, k in enumerate(st["keys"])}
+            elif opt == self.optimizer == "ftrl" and "slot_z" in st:
+                self._slots = {
+                    int(k): [st["slot_z"][i].astype(np.float32),
+                             st["slot_n"][i].astype(np.float32)]
                     for i, k in enumerate(st["keys"])}
 
     def apply_delta(self, ids, deltas):
@@ -401,7 +440,9 @@ def _srv_shutdown():
 _FAILOVER_TIMEOUT_S = float(os.environ.get("FLAGS_ps_failover_timeout", 60))
 
 
-def _call_on(worker, fn, *args, **kwargs):
+def _call_on(worker, fn, *args, _retry_args=None, **kwargs):
+    """_retry_args: the args to use on RETRY attempts when the call is not
+    idempotent under its original args (a show-recording pull)."""
     if worker is None:
         return fn(*args, **kwargs)
     import time
@@ -409,9 +450,12 @@ def _call_on(worker, fn, *args, **kwargs):
     from paddle_tpu.distributed import rpc
 
     deadline = time.time() + _FAILOVER_TIMEOUT_S
+    first = True
     while True:
         try:
-            return rpc.rpc_sync(worker, fn, args=args, kwargs=kwargs)
+            use = args if (first or _retry_args is None) else _retry_args
+            first = False
+            return rpc.rpc_sync(worker, fn, args=use, kwargs=kwargs)
         except (ConnectionError, EOFError, OSError):
             # server shard down: keep retrying against the (possibly
             # re-published) endpoint until the supervisor restarts it —
@@ -442,13 +486,22 @@ def _fanout(srv_fn, name, ids, row_extras=(), extra_args=(), gather=True):
     extra_args: scalars appended to every shard call (lr, flags)."""
     ids = np.asarray(ids)
     flat = ids.ravel()
+    def _no_show_retry(args_tuple):
+        # see result(): a retried show-recording pull must not re-count
+        if srv_fn is not _srv_pull_sparse:
+            return None
+        base = args_tuple[:2 + len(row_extras)]
+        tail = ((False,) + tuple(extra_args[1:])) if extra_args else (False,)
+        return base + tail
+
     if not _server_workers or len(_server_workers) == 1:
         w = _server_workers[0] if _server_workers else None
-        return _call_on(w, srv_fn, name, flat,
-                        *[e for e in row_extras], *extra_args)
+        a = (name, flat, *[e for e in row_extras], *extra_args)
+        return _call_on(w, srv_fn, *a, _retry_args=_no_show_retry(a))
     if flat.size == 0:  # shape must match the 1-server path ((0, dim) pulls)
-        return _call_on(_server_workers[0], srv_fn, name, flat,
-                        *[e for e in row_extras], *extra_args)
+        a = (name, flat, *[e for e in row_extras], *extra_args)
+        return _call_on(_server_workers[0], srv_fn, *a,
+                        _retry_args=_no_show_retry(a))
     parts = {}
     for i, k in enumerate(flat):
         parts.setdefault(_shard_of(k), []).append(i)
@@ -465,9 +518,16 @@ def _fanout(srv_fn, name, ids, row_extras=(), extra_args=(), gather=True):
         try:
             return f.wait()
         except (ConnectionError, EOFError, OSError):
-            # shard died mid-flight: _call_on retries with failover
-            return _call_on(w, srv_fn, name, flat[idxs], *sliced,
-                            *extra_args)
+            # shard died mid-flight: _call_on retries with failover. A
+            # retried show-recording pull must NOT re-count the impression
+            # (the server may have processed the original and only the
+            # reply was lost) — retry with record_show=False; mutating
+            # calls are protected by their req_id instead.
+            retry = extra_args
+            if srv_fn is _srv_pull_sparse:
+                retry = (False,) + tuple(extra_args[1:]) if extra_args \
+                    else (False,)
+            return _call_on(w, srv_fn, name, flat[idxs], *sliced, *retry)
 
     if not gather:
         for w, idxs, sliced, f in futs:

@@ -313,3 +313,77 @@ def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCL", output_size=None, name=None):
     return _max_unpool(x, indices, 1, kernel_size, stride, padding,
                        output_size, data_format, name)
+
+
+def _fractional_bounds(n_in, n_out, u):
+    """Pseudo-random pooling boundaries (Graham 2014; reference
+    fractional_max_pool kernels): alpha = n_in / n_out, index(i) =
+    ceil(alpha * (i + u)) with u in (0, 1); bin i spans
+    [index(i-1), index(i))."""
+    alpha = n_in / n_out
+    idx = np.ceil(alpha * (np.arange(n_out + 1) + u)).astype(np.int64) - 1
+    idx[0] = 0
+    idx[-1] = n_in
+    return idx
+
+
+def _fractional_max(x, output_size, kernel_size, random_u, return_mask,
+                    ndim, name):
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    spatial = xd.shape[2:]
+    outs = _tuple(output_size, ndim)
+    u = float(random_u) if random_u is not None else 0.5
+    if not (0 < u < 1):
+        raise ValueError("random_u must be in (0, 1)")
+    bounds = [_fractional_bounds(spatial[d], outs[d], u)
+              for d in range(ndim)]
+    kmax = [int(np.max(np.diff(b))) for b in bounds]
+    if kernel_size is not None:
+        ks = _tuple(kernel_size, ndim)
+        kmax = [max(k, m) for k, m in zip(ks, kmax)]
+
+    # gather each output bin's (padded-to-kmax) window and reduce: static
+    # shapes, one fused gather+max per dim
+    def pool_dim(v, d):
+        b = bounds[d]
+        starts = b[:-1]
+        width = kmax[d]
+        idx = starts[:, None] + np.arange(width)[None, :]
+        valid = idx < b[1:, None]
+        idx = np.minimum(idx, spatial[d] - 1)
+        axis = 2 + d
+        g = jnp.take(v, jnp.asarray(idx.reshape(-1)), axis=axis)
+        new_shape = (v.shape[:axis] + (len(starts), width)
+                     + v.shape[axis + 1:])
+        g = g.reshape(new_shape)
+        mask_shape = [1] * g.ndim
+        mask_shape[axis], mask_shape[axis + 1] = len(starts), width
+        m = jnp.asarray(valid).reshape(mask_shape)
+        g = jnp.where(m, g, -jnp.inf)
+        return jnp.max(g, axis=axis + 1)
+
+    out = xd
+    for d in range(ndim):
+        out = pool_dim(out, d)
+    out = out.astype(xd.dtype)
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool return_mask: use return_mask=False on "
+            "this backend (the mask only feeds the legacy unpool path)")
+    return Tensor(out)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (reference fractional_max_pool2d,
+    `phi/kernels/.../fractional_max_pool2d_kernel`; Graham 2014): the
+    pseudo-random bin boundaries come from `random_u` (deterministic for
+    a given u, like the reference's seeded kernel)."""
+    return _fractional_max(x, output_size, kernel_size, random_u,
+                           return_mask, 2, name)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max(x, output_size, kernel_size, random_u,
+                           return_mask, 3, name)

@@ -512,3 +512,109 @@ def unstack(x, axis=0, num=None, name=None):
 def shape(input, name=None):
     """Shape as a 1-D int32 tensor (reference ops.yaml shape/shape64)."""
     return Tensor(jnp.asarray(input.shape, jnp.int32))
+
+
+# -- padded-sequence ops (the reference's LoD sequence stack, r5 tail) -------
+
+
+def sequence_pool(x, pool_type=None, lengths=None, pad_value=0.0,
+                  is_test=False, pooltype="SUM", name=None):
+    """Pool each sequence to one vector (reference sequence_pool op,
+    `phi/kernels/funcs/sequence_pooling.cc`). The reference packs ragged
+    sequences with LoD; here x is PADDED [B, T, D] with `lengths` [B]
+    marking the valid prefix (None = all valid). pool_type: SUM / MEAN /
+    MAX / MIN / SQRT (sum / sqrt(len)) / LAST / FIRST."""
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    B, T = xd.shape[0], xd.shape[1]
+    ln = (jnp.asarray(lengths._data if isinstance(lengths, Tensor)
+                      else lengths).reshape(B).astype(jnp.int32)
+          if lengths is not None else jnp.full((B,), T, jnp.int32))
+    valid = (jnp.arange(T)[None, :] < ln[:, None])
+    vmask = valid.reshape(B, T, *([1] * (xd.ndim - 2)))
+    pt = (pool_type if pool_type is not None else pooltype).upper()
+    if pt == "AVERAGE":
+        pt = "MEAN"
+    x32 = xd.astype(jnp.float32)
+    denom = jnp.maximum(ln.astype(jnp.float32), 1.0).reshape(
+        B, *([1] * (xd.ndim - 2)))
+    if pt == "SUM":
+        out = jnp.sum(jnp.where(vmask, x32, 0.0), axis=1)
+    elif pt == "MEAN":
+        out = jnp.sum(jnp.where(vmask, x32, 0.0), axis=1) / denom
+    elif pt == "SQRT":
+        out = jnp.sum(jnp.where(vmask, x32, 0.0), axis=1) / jnp.sqrt(denom)
+    elif pt == "MAX":
+        out = jnp.max(jnp.where(vmask, x32, -jnp.inf), axis=1)
+    elif pt == "MIN":
+        out = jnp.min(jnp.where(vmask, x32, jnp.inf), axis=1)
+    elif pt == "LAST":
+        idx = jnp.maximum(ln - 1, 0)
+        out = jnp.take_along_axis(
+            x32, idx.reshape(B, 1, *([1] * (xd.ndim - 2))), axis=1)[:, 0]
+    elif pt == "FIRST":
+        out = x32[:, 0]
+    else:
+        raise ValueError(f"unknown pool_type {pool_type!r}")
+    return Tensor(out.astype(xd.dtype))
+
+
+def sequence_conv(x, weight=None, bias=None, context_length=3,
+                  context_start=None, padding_data=None, filter=None,
+                  padding_trainable=False, context_stride=1, lengths=None,
+                  name=None):
+    """Context-window sequence convolution (reference sequence_conv op):
+    each position concatenates `context_length` neighbouring steps
+    (starting at context_start, default -(L-1)//2) and matmuls
+    weight [context_length * D, M]. Padded [B, T, D] layout; out-of-range
+    context is zero (the reference's zero up-padding)."""
+    if weight is None:
+        weight = filter  # yaml arg name (ops.yaml sequence_conv)
+    if padding_trainable or padding_data is not None:
+        raise NotImplementedError(
+            "sequence_conv: trainable context padding is not implemented "
+            "on this backend; out-of-range context is zero")
+    if context_stride != 1:
+        raise NotImplementedError("sequence_conv: context_stride must be 1 "
+                                  "(the reference kernel has the same "
+                                  "restriction)")
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    wd = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    B, T, D = xd.shape
+    L = int(context_length)
+    start = -((L - 1) // 2) if context_start is None else int(context_start)
+    cols = []
+    for off in range(start, start + L):
+        shifted = jnp.roll(xd, -off, axis=1)
+        t = jnp.arange(T)
+        ok = ((t + off >= 0) & (t + off < T))[None, :, None]
+        cols.append(jnp.where(ok, shifted, 0))
+    ctx = jnp.concatenate(cols, axis=-1)  # [B, T, L*D]
+    out = ctx @ wd
+    if bias is not None:
+        bd = bias._data if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out = out + bd
+    if lengths is not None:
+        ln = jnp.asarray(lengths._data if isinstance(lengths, Tensor)
+                         else lengths).reshape(B).astype(jnp.int32)
+        out = jnp.where((jnp.arange(T)[None, :] < ln[:, None])[..., None],
+                        out, 0)
+    return Tensor(out)
+
+
+def im2sequence(x, kernels, strides=(1, 1), paddings=(0, 0, 0, 0),
+                out_stride=(1, 1), name=None):
+    """Sliding-window patches -> sequence (reference im2sequence op, the
+    legacy OCR front end): x [B, C, H, W] -> [B, nH*nW, C*kh*kw] via
+    XLA's native patch extraction."""
+    import jax.lax as lax
+
+    xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    kh, kw = kernels
+    sh, sw = strides
+    pu, pd, pl, pr = paddings
+    patches = lax.conv_general_dilated_patches(
+        xd, (kh, kw), (sh, sw), [(pu, pd), (pl, pr)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    B, CKK, nH, nW = patches.shape
+    out = patches.reshape(B, CKK, nH * nW).transpose(0, 2, 1)
+    return Tensor(out)

@@ -5,6 +5,7 @@ import builtins as _builtins
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.tensor import Tensor, apply, to_tensor
 
@@ -469,3 +470,174 @@ def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
         return jnp.moveaxis(a2, (-2, -1), (d1, d2))
 
     return apply(fn, x, y, _name="fill_diagonal_tensor")
+
+
+# -- legacy/aux training ops (r5 op-tail sweep) ------------------------------
+
+
+def affine_channel(x, scale, bias, data_layout="NCHW", name=None):
+    """Per-channel affine y = x * scale[C] + bias[C] (reference
+    `ops.yaml` affine_channel, `phi/kernels/impl/affine_channel_*`):
+    the frozen-BatchNorm replacement in legacy detection models."""
+    def fn(xv, s, b):
+        if data_layout in ("NCHW", "NCDHW"):
+            shape = (1, -1) + (1,) * (xv.ndim - 2)
+        else:
+            shape = (1,) * (xv.ndim - 1) + (-1,)
+        return xv * s.reshape(shape) + b.reshape(shape)
+
+    return apply(fn, x, scale, bias)
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
+    """out = alpha * x + beta * sinusoidal_PE (reference
+    add_position_encoding op): x is [B, T, D] (D even), PE the standard
+    interleaved sin/cos table."""
+    def fn(xv):
+        B, T, D = xv.shape
+        half = D // 2
+        pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+        ang = pos / div[None, :]
+        pe = jnp.concatenate([jnp.sin(ang), jnp.cos(ang)], axis=-1)
+        return (alpha * xv + beta * pe[None].astype(xv.dtype))
+
+    return apply(fn, x)
+
+
+def cvm(x, cvm_in, use_cvm=True, name=None):
+    """Continuous-value-model feature op for CTR models (reference cvm op,
+    `phi/kernels/cpu/cvm_kernel.cc`): x [B, D] embeddings whose first two
+    slots carry show/click; cvm_in [B, 2] the raw (show, click) counters.
+    use_cvm=True rewrites the slots to (log(show+1),
+    log(click+1) - log(show+1)); False drops them."""
+    def fn(xv, c):
+        logs = jnp.log(c.astype(jnp.float32) + 1.0)
+        feat = jnp.stack([logs[:, 0], logs[:, 1] - logs[:, 0]], axis=1)
+        if use_cvm:
+            return jnp.concatenate(
+                [feat.astype(xv.dtype), xv[:, 2:]], axis=1)
+        return xv[:, 2:]
+
+    return apply(fn, x, cvm_in)
+
+
+def dgc_clip_by_norm(x, current_step=0.0, max_norm=1.0,
+                     rampup_begin_step=-1.0, name=None):
+    """clip_by_norm as used by deep gradient compression (reference dgc
+    ops): rampup_begin_step < 0 disables DGC -> plain clip."""
+    def fn(xv):
+        n = jnp.sqrt(jnp.sum(jnp.square(xv.astype(jnp.float32))))
+        coef = jnp.minimum(max_norm / jnp.maximum(n, 1e-12), 1.0)
+        return (xv.astype(jnp.float32) * coef).astype(xv.dtype)
+
+    return apply(fn, x)
+
+
+def dgc_momentum(param, grad, velocity, learning_rate=0.001,
+                 master_param=None, current_step_tensor=None,
+                 nranks_tensor=None, mu=0.9, use_nesterov=False,
+                 regularization_method="", regularization_coeff=0.0,
+                 multi_precision=False, rescale_grad=1.0,
+                 rampup_begin_step=0.0, current_step=0.0, name=None):
+    """DGC's gated momentum (reference dgc_momentum op): before the DGC
+    rampup begins the update is plain momentum; afterwards the momentum
+    accumulation happens inside dgc() itself, so this op passes grads
+    through. Returns (update, new_velocity)."""
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    if current_step_tensor is not None:
+        current_step = float(np.asarray(
+            current_step_tensor._data
+            if isinstance(current_step_tensor, _T)
+            else current_step_tensor))
+    p = param._data if isinstance(param, _T) else jnp.asarray(param)
+    g = (grad._data if isinstance(grad, _T)
+         else jnp.asarray(grad)).astype(jnp.float32)
+    v = (velocity._data if isinstance(velocity, _T)
+         else jnp.asarray(velocity)).astype(jnp.float32)
+    lr = (learning_rate._data if isinstance(learning_rate, _T)
+          else jnp.asarray(learning_rate)).astype(jnp.float32)
+    g = g * rescale_grad
+    if regularization_method == "l2_decay" and regularization_coeff:
+        g = g + regularization_coeff * p.astype(jnp.float32)
+    new_v = mu * v + g
+    upd = g + mu * new_v if use_nesterov else new_v
+    gate = jnp.float32(current_step < rampup_begin_step)
+    upd = gate * upd + (1 - gate) * g
+    new_v = gate * new_v + (1 - gate) * v
+    p_out = (p.astype(jnp.float32) - lr * upd).astype(p.dtype)
+    return _T(p_out), _T(new_v.astype(jnp.float32))
+
+
+def dgc(u, v, grad, param=None, current_step=1.0, nranks=1,
+        m=0.9, use_nesterov=False, sparsity=(), rampup_begin_step=0.0,
+        rampup_step=1.0, regular_coeff=0.0, regular_type=0,
+        ratio=0.001, name=None):
+    """Deep gradient compression (reference dgc op, Lin et al. 2018 —
+    public recipe): momentum-corrected top-k gradient sparsification with
+    local error feedback. Returns (new_u, new_v, k_grad, gather_mask):
+    k_grad keeps only the top `ratio` fraction of |u+v| entries (the
+    values a rank would allreduce), the residual stays in u/v.
+
+    TPU-native: a dense top-k threshold mask instead of index lists —
+    collectives on this stack ride psum of the masked dense tensor."""
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    ud = u._data if isinstance(u, _T) else jnp.asarray(u)
+    vd = v._data if isinstance(v, _T) else jnp.asarray(v)
+    gd = grad._data if isinstance(grad, _T) else jnp.asarray(grad)
+    g32 = gd.astype(jnp.float32).reshape(-1)
+    if param is not None and regular_coeff and regular_type:
+        pd = (param._data if isinstance(param, _T)
+              else jnp.asarray(param)).astype(jnp.float32).reshape(-1)
+        # regular_type: 1 = L1, 2 = L2 (reference dgc op regularization)
+        g32 = g32 + regular_coeff * (jnp.sign(pd) if regular_type == 1
+                                     else pd)
+    if len(sparsity):
+        # the rampup schedule: sparsity[k] is the target fraction DROPPED
+        # at rampup period k; keep-ratio = 1 - sparsity
+        k_idx = 0 if rampup_step <= 0 else int(
+            min(max(current_step - rampup_begin_step, 0.0) // rampup_step,
+                len(sparsity) - 1))
+        ratio = 1.0 - float(sparsity[k_idx])
+    u32 = ud.astype(jnp.float32).reshape(-1)
+    v32 = vd.astype(jnp.float32).reshape(-1)
+    new_u = m * u32 + g32                   # momentum correction
+    new_v = v32 + new_u                     # error accumulation
+    k = _builtins.max(1, int(g32.size * float(ratio) + 0.5))
+    thresh = jax.lax.top_k(jnp.abs(new_v), k)[0][-1]
+    mask = jnp.abs(new_v) >= thresh
+    k_grad = jnp.where(mask, new_v, 0.0)
+    new_v = jnp.where(mask, 0.0, new_v)     # error feedback: keep residual
+    new_u = jnp.where(mask, 0.0, new_u)
+    shape = gd.shape
+    return (_T(new_u.reshape(shape).astype(ud.dtype)),
+            _T(new_v.reshape(shape).astype(vd.dtype)),
+            _T(k_grad.reshape(shape).astype(gd.dtype)),
+            _T(mask.reshape(shape)))
+
+
+def dpsgd(param, grad, learning_rate=0.01, clip=1.0, batch_size=1.0,
+          sigma=1.0, seed=0, name=None):
+    """Differentially-private SGD update (reference dpsgd op): per-batch
+    gradient L2-clip to `clip`, Gaussian noise sigma*clip, then SGD."""
+    from paddle_tpu.core.tensor import Tensor as _T
+
+    p = param._data if isinstance(param, _T) else jnp.asarray(param)
+    g = (grad._data if isinstance(grad, _T) else jnp.asarray(grad)).astype(
+        jnp.float32)
+    n = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g * jnp.minimum(1.0, clip / jnp.maximum(n, 1e-12))
+    if seed in (None, 0):
+        # fresh noise per call (seed=0 means non-deterministic, like the
+        # reference); a FIXED key would add the same vector every step and
+        # void the DP guarantee
+        from paddle_tpu.framework import random as _fr
+
+        key = _fr.next_key()
+    else:
+        key = jax.random.key(seed)
+    noise = jax.random.normal(key, g.shape, jnp.float32) * sigma * clip
+    upd = (g + noise) / batch_size
+    return _T((p.astype(jnp.float32) - learning_rate * upd).astype(p.dtype))

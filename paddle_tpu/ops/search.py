@@ -1,5 +1,6 @@
 """Search/sort ops (reference: `python/paddle/tensor/search.py`)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -180,3 +181,239 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
         d, n = one(list(a[i][:int(il[i])]), list(b[i][:int(ll[i])]))
         out[i, 0] = d / max(n, 1) if normalized else d
     return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(a.shape[0]))
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, level=0, beam_size=4,
+                end_id=0, is_accumulated=True, name=None):
+    """One beam-search expansion step (reference `ops.yaml:538` beam_search,
+    `phi/kernels/cpu/beam_search_kernel.cc`).
+
+    TPU-native shapes instead of the reference's LoD packing: per batch
+    entry, K live beams each scoring a V-vocab step —
+      pre_ids     [B, K] int    current last token per beam
+      pre_scores  [B, K] float  accumulated log-prob per beam
+      scores      [B, K, V]     this step's log-probs (already accumulated
+                                when is_accumulated, the usual case)
+      ids                       optional candidate remap [B, K, V] (None:
+                                candidate v IS token v)
+    Returns (selected_ids [B, K], selected_scores [B, K],
+    parent_idx [B, K]) — the top-K continuations and the beam each one
+    extends. FINISHED beams (pre_ids == end_id) contribute exactly one
+    candidate: end_id at their unchanged score (the reference kernel's
+    early-finish handling), so the schedule composes into a lax.scan/
+    while_loop decode loop with static shapes."""
+    p_ids = pre_ids._data if isinstance(pre_ids, Tensor) else jnp.asarray(pre_ids)
+    p_sc = (pre_scores._data if isinstance(pre_scores, Tensor)
+            else jnp.asarray(pre_scores)).astype(jnp.float32)
+    sc = (scores._data if isinstance(scores, Tensor)
+          else jnp.asarray(scores)).astype(jnp.float32)
+    B, K, V = sc.shape
+    if not is_accumulated:
+        sc = p_sc[..., None] + jnp.log(jnp.maximum(sc, 1e-30))
+    finished = p_ids == end_id
+    NEG = jnp.float32(-1e30)
+    # finished beams: their only candidate is end_id at the frozen score
+    end_col = jnp.arange(V)[None, None, :] == end_id
+    fin_sc = jnp.where(end_col, p_sc[..., None], NEG)
+    sc = jnp.where(finished[..., None], fin_sc, sc)
+    flat = sc.reshape(B, K * V)
+    top, pos = jax.lax.top_k(flat, min(beam_size, K * V))
+    parent = (pos // V).astype(jnp.int64)
+    token = (pos % V).astype(jnp.int64)
+    if ids is not None:
+        cand = ids._data if isinstance(ids, Tensor) else jnp.asarray(ids)
+        token = jnp.take_along_axis(
+            cand.reshape(B, K * V), pos, axis=1).astype(jnp.int64)
+        # the remap must not resurrect a FINISHED beam: selections whose
+        # parent had already emitted end_id stay end_id
+        par_fin = jnp.take_along_axis(finished, parent.astype(jnp.int32),
+                                      axis=1)
+        token = jnp.where(par_fin, jnp.int64(end_id), token)
+    return Tensor(token), Tensor(top), Tensor(parent)
+
+
+def beam_search_decode(step_ids, parent_idx, beam_size=None, end_id=0,
+                       name=None):
+    """Backtrack beam-search steps into full sequences (reference
+    `beam_search_decode_op`): step_ids/parent_idx [T, B, K] from T calls
+    of beam_search. Returns (sequences [B, K, T], sequence scores are the
+    caller's final beam scores). Implemented as a reverse lax.scan — the
+    whole decode stays on device."""
+    ids = (step_ids._data if isinstance(step_ids, Tensor)
+           else jnp.asarray(step_ids))
+    par = (parent_idx._data if isinstance(parent_idx, Tensor)
+           else jnp.asarray(parent_idx))
+    T, B, K = ids.shape
+    binx = jnp.arange(B)[:, None]
+
+    def back(beam, t):
+        tok = ids[t][binx, beam]          # [B, K]
+        beam = par[t][binx, beam]
+        return beam, tok
+
+    import jax as _jax
+
+    _, toks = _jax.lax.scan(back, jnp.tile(jnp.arange(K)[None], (B, 1)),
+                            jnp.arange(T - 1, -1, -1))
+    # toks: [T, B, K] in reverse time order -> [B, K, T] forward
+    return Tensor(jnp.flip(toks, axis=0).transpose(1, 2, 0))
+
+
+def chunk_eval(inference, label, chunk_scheme="IOB", num_chunk_types=1,
+               excluded_chunk_types=None, seq_length=None, name=None):
+    """Chunk-level precision/recall/F1 for sequence labeling (reference
+    `ops.yaml:5470` chunk_eval, `phi/kernels/cpu/chunk_eval_kernel.cc` —
+    the NER evaluation op). Schemes: IOB (tags B,I per type), IOE (I,E),
+    IOBES (B,I,E,S), plain (each tag is a single-token chunk of its
+    type). Tag encoding matches the reference: tag = type * n + pos with
+    n tags per type, and type == num_chunk_types means Outside.
+
+    Host-side metric (like the reference's CPU-only kernel); returns
+    (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks)."""
+    schemes = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    if chunk_scheme not in schemes:
+        raise ValueError(f"unknown chunk_scheme {chunk_scheme!r}")
+    npos = schemes[chunk_scheme]
+    excl = set(excluded_chunk_types or ())
+
+    inf = np.asarray(inference._data if isinstance(inference, Tensor)
+                     else inference)
+    lab = np.asarray(label._data if isinstance(label, Tensor) else label)
+    if inf.ndim == 1:
+        inf, lab = inf[None], lab[None]
+    inf = inf.reshape(inf.shape[0], -1)
+    lab = lab.reshape(lab.shape[0], -1)
+    lens = (np.asarray(seq_length._data if isinstance(seq_length, Tensor)
+                       else seq_length).reshape(-1)
+            if seq_length is not None
+            else np.full(inf.shape[0], inf.shape[1]))
+
+    out_tag = num_chunk_types * npos  # first tag id that means Outside
+
+    def chunks(seq):
+        """Set of (start, end, type) chunks of one tag sequence."""
+        got = set()
+        start = None
+        ctype = None
+        for i, t in enumerate(list(seq) + [out_tag]):
+            t = int(t)
+            ttype, pos = (t // npos, t % npos) if t < out_tag else (None, None)
+            # does the RUNNING chunk end before token i?
+            ends = start is not None and (
+                ttype != ctype
+                or (chunk_scheme == "IOB" and pos == 0)      # new B
+                or (chunk_scheme == "IOBES" and pos in (0, 3)))
+            if chunk_scheme == "IOE" and start is not None and \
+                    ttype == ctype and i > 0 and int(seq[i - 1]) % npos == 1:
+                ends = True  # previous token was E: chunk closed
+            if chunk_scheme == "plain":
+                ends = start is not None
+            if ends:
+                if ctype not in excl:
+                    got.add((start, i - 1, ctype))
+                start, ctype = None, None
+            if ttype is not None and start is None:
+                begins = True
+                if chunk_scheme == "IOBES" and pos == 1:
+                    begins = True  # stray I still opens (reference lenient)
+                if begins:
+                    start, ctype = i, ttype
+                if chunk_scheme == "IOBES" and pos == 3:  # S: singleton
+                    if ctype not in excl:
+                        got.add((i, i, ctype))
+                    start, ctype = None, None
+        return got
+
+    n_inf = n_lab = n_cor = 0
+    for b in range(inf.shape[0]):
+        L = int(lens[b])
+        ci = chunks(inf[b][:L])
+        cl = chunks(lab[b][:L])
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_cor += len(ci & cl)
+    prec = n_cor / n_inf if n_inf else 0.0
+    rec = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    mk = lambda v, dt: Tensor(jnp.asarray([v], dt))  # noqa: E731
+    return (mk(prec, jnp.float32), mk(rec, jnp.float32),
+            mk(f1, jnp.float32), mk(n_inf, jnp.int64),
+            mk(n_lab, jnp.int64), mk(n_cor, jnp.int64))
+
+
+def crf_decoding(emission, transition, label=None, length=None, name=None):
+    """Viterbi decode of a linear-chain CRF (reference crf_decoding op,
+    `phi/kernels/cpu/crf_decoding_kernel.cc`). emission [B, T, N] (or
+    [T, N]); transition [N+2, N]: row 0 = start scores, row 1 = end
+    scores, rows 2.. = pairwise transitions. Returns the argmax tag path
+    [B, T] (with `label` given, returns the 0/1 correctness mask like the
+    reference). One lax.scan forward + one backtrack scan — the whole
+    decode compiles."""
+    e = (emission._data if isinstance(emission, Tensor)
+         else jnp.asarray(emission)).astype(jnp.float32)
+    w = (transition._data if isinstance(transition, Tensor)
+         else jnp.asarray(transition)).astype(jnp.float32)
+    squeeze = e.ndim == 2
+    if squeeze:
+        e = e[None]
+    B, T, N = e.shape
+    start, end, trans = w[0], w[1], w[2:]
+
+    def viterbi(em):
+        def fwd(alpha, obs):
+            score = alpha[:, None] + trans + obs[None, :]
+            return jnp.max(score, axis=0), jnp.argmax(score, axis=0)
+
+        alpha0 = start + em[0]
+        alpha, back = jax.lax.scan(fwd, alpha0, em[1:])
+        alpha = alpha + end
+        last = jnp.argmax(alpha)
+
+        def backtrack(tag, bp):
+            prev = bp[tag]
+            # consuming back_{k+1} turns tag_{k+1} into tag_k, which is
+            # exactly ys[k] under reverse=True
+            return prev, prev
+
+        _, path = jax.lax.scan(backtrack, last, back, reverse=True)
+        return jnp.concatenate([path, last[None]]).astype(jnp.int64)
+
+    path = jax.vmap(viterbi)(e)
+    if label is not None:
+        lab = (label._data if isinstance(label, Tensor)
+               else jnp.asarray(label)).reshape(B, T)
+        out = (path == lab).astype(jnp.int64)
+        return Tensor(out[0] if squeeze else out)
+    return Tensor(path[0] if squeeze else path)
+
+
+def ctc_align(input, blank=0, merge_repeated=True, padding_value=0,
+              input_length=None, name=None):
+    """CTC best-path alignment (reference ctc_align op): collapse repeated
+    tokens, drop blanks, left-pack, pad with padding_value. input [B, T]
+    token ids. Host-side (output packing is data-dependent), like the
+    reference's CPU-only kernel."""
+    a = np.asarray(input._data if isinstance(input, Tensor) else input)
+    squeeze = a.ndim == 1
+    if squeeze:
+        a = a[None]
+    lens = (np.asarray(input_length._data
+                       if isinstance(input_length, Tensor)
+                       else input_length).reshape(-1)
+            if input_length is not None
+            else np.full(a.shape[0], a.shape[1]))
+    out = np.full_like(a, padding_value)
+    out_lens = np.zeros(a.shape[0], np.int64)
+    for b in range(a.shape[0]):
+        prev = None
+        j = 0
+        for t in range(int(lens[b])):
+            tok = int(a[b, t])
+            if tok != blank and not (merge_repeated and tok == prev):
+                out[b, j] = tok
+                j += 1
+            prev = tok
+        out_lens[b] = j
+    res = Tensor(jnp.asarray(out[0] if squeeze else out))
+    return res, Tensor(jnp.asarray(out_lens))

@@ -17,7 +17,8 @@ import numpy as np
 from paddle_tpu.core.tensor import Tensor, apply
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "prior_box",
-           "box_area", "box_iou", "distribute_fpn_proposals"]
+           "box_area", "box_iou", "distribute_fpn_proposals",
+           "box_clip", "bipartite_match", "collect_fpn_proposals"]
 
 
 def _data(x):
@@ -332,3 +333,405 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     if rn is not None:
         return outs, restore_t, per_level_counts
     return outs, restore_t
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to the image boundary (reference
+    `paddle/phi/ops/yaml/ops.yaml:715` box_clip,
+    `phi/kernels/cpu/box_clip_kernel.cc`): im_info rows are
+    (height, width, scale); boxes live in the UN-scaled input image, so
+    the limits are (dim / scale) - 1. Pure elementwise min/max —
+    differentiable (clip's subgradient), vectorizes trivially."""
+    def fn(b, info):
+        info = info.astype(jnp.float32)
+        if b.ndim != 3:
+            info = info.reshape(-1)[:3]
+            lim_h = info[0] / info[2] - 1.0
+            lim_w = info[1] / info[2] - 1.0
+        else:
+            lim_h = (info[:, 0] / info[:, 2] - 1.0)[:, None, None]
+            lim_w = (info[:, 1] / info[:, 2] - 1.0)[:, None, None]
+        x1, y1, x2, y2 = (b[..., 0:1], b[..., 1:2], b[..., 2:3],
+                          b[..., 3:4])
+        zero = jnp.zeros((), b.dtype)
+
+        def cl(v, lim):
+            return jnp.maximum(jnp.minimum(v, lim.astype(b.dtype)), zero)
+
+        return jnp.concatenate(
+            [cl(x1, lim_w), cl(y1, lim_h), cl(x2, lim_w), cl(y2, lim_h)],
+            axis=-1)
+
+    return apply(fn, input, im_info, _name="box_clip")
+
+
+def bipartite_match(dist_matrix, match_type="bipartite",
+                    dist_threshold=0.5, name=None):
+    """Greedy bipartite matching on a similarity matrix (reference
+    `ops.yaml:620` bipartite_match, `phi/kernels/cpu/bipartite_match_kernel.cc`
+    — the SSD/MultiBox target-assignment op).
+
+    dist_matrix: [n, m] (or [B, n, m]) similarities, rows = candidates
+    (e.g. ground-truth), cols = predictions (e.g. priors). Returns
+    (col_to_row_match_indices, col_to_row_match_dist), each [B?, m]:
+    column j's matched row (or -1) and its similarity.
+
+    TPU-native: min(n, m) iterations of a global argmax with matched
+    rows/cols masked out — a lax.fori_loop over a static bound, no
+    host round trips. match_type='per_prediction' additionally matches
+    every still-unmatched column to its argmax row when the similarity
+    reaches dist_threshold."""
+    if match_type not in ("bipartite", "per_prediction"):
+        raise ValueError("match_type must be 'bipartite' or "
+                         "'per_prediction'")
+    d = _data(dist_matrix).astype(jnp.float32)
+    batched = d.ndim == 3
+    if not batched:
+        d = d[None]
+
+    B, n, m = d.shape
+    NEG = jnp.float32(-1e30)
+
+    def one(mat):
+        def body(_, carry):
+            work, idx, dist = carry
+            flat = jnp.argmax(work)
+            i, j = flat // m, flat % m
+            best = work[i, j]
+            ok = best > NEG / 2  # anything left to match?
+            idx = jnp.where(ok, idx.at[j].set(i), idx)
+            dist = jnp.where(ok, dist.at[j].set(best), dist)
+            work = jnp.where(ok, work.at[i, :].set(NEG), work)
+            work = jnp.where(ok, work.at[:, j].set(NEG), work)
+            return work, idx, dist
+
+        idx0 = jnp.full((m,), -1, jnp.int32)
+        dist0 = jnp.zeros((m,), jnp.float32)
+        work, idx, dist = jax.lax.fori_loop(
+            0, min(n, m), body, (mat, idx0, dist0))
+        if match_type == "per_prediction":
+            cand = jnp.argmax(mat, axis=0)
+            cand_d = jnp.max(mat, axis=0)
+            take = (idx < 0) & (cand_d >= dist_threshold)
+            idx = jnp.where(take, cand.astype(jnp.int32), idx)
+            dist = jnp.where(take, cand_d, dist)
+        return idx, dist
+
+    idx, dist = jax.vmap(one)(d)
+    if not batched:
+        idx, dist = idx[0], dist[0]
+    return Tensor(idx), Tensor(dist)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level=None,
+                          max_level=None, post_nms_top_n=-1,
+                          rois_num_per_level=None, name=None):
+    """Collect proposals across FPN levels and keep the post_nms_top_n
+    highest-scoring (reference `ops.yaml:971` collect_fpn_proposals,
+    `phi/kernels/.../collect_fpn_proposals_kernel`): concat + one top_k —
+    static shapes, single fused XLA program."""
+    rois = jnp.concatenate([_data(r) for r in multi_rois], axis=0)
+    scores = jnp.concatenate(
+        [_data(s).reshape(-1) for s in multi_scores], axis=0)
+    if rois_num_per_level is None:
+        # single-image form: one global top-k on device
+        k = scores.shape[0] if post_nms_top_n in (-1, None) \
+            else min(int(post_nms_top_n), scores.shape[0])
+        top, sel = jax.lax.top_k(scores, k)
+        out = jnp.take(rois, sel, axis=0)
+        return Tensor(out), Tensor(jnp.asarray([k], jnp.int32))
+    # batched form: rois_num_per_level[l] is a [B] split of level l —
+    # collect PER IMAGE (the reference's multi_level_rois_num path) so a
+    # batch's proposals never mix; ragged packing is host-side
+    per_level = [np.asarray(_data(n)).ravel() for n in rois_num_per_level]
+    B = len(per_level[0])
+    rois_h = np.asarray(rois, np.float32)
+    sc_h = np.asarray(scores, np.float32)
+    level_off = np.cumsum([0] + [int(p.sum()) for p in per_level])
+    outs, counts = [], []
+    for bi in range(B):
+        idxs = []
+        for li, p in enumerate(per_level):
+            s = level_off[li] + int(p[:bi].sum())
+            idxs.extend(range(s, s + int(p[bi])))
+        idxs = np.asarray(idxs, np.int64)
+        order = idxs[np.argsort(-sc_h[idxs])]
+        if post_nms_top_n not in (-1, None):
+            order = order[:int(post_nms_top_n)]
+        outs.append(rois_h[order])
+        counts.append(len(order))
+    out = (np.concatenate(outs, axis=0) if outs
+           else np.zeros((0, 4), np.float32))
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode a YOLOv3 head into boxes + class scores (reference yolo_box,
+    `phi/kernels/.../yolo_box_kernel`): x [B, A*(5+C), H, W] with A =
+    len(anchors)//2. Returns (boxes [B, H*W*A, 4] in xyxy image coords,
+    scores [B, H*W*A, C]). Pure elementwise grid math — one fused XLA
+    program, no host round trip. Detections under conf_thresh get zeroed
+    scores (the dense-shape analogue of the reference's filtering)."""
+    xd = _data(x).astype(jnp.float32)
+    im = _data(img_size).astype(jnp.float32)
+    B, _, H, W = xd.shape
+    A = len(anchors) // 2
+    C = int(class_num)
+    an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+    feat = xd.reshape(B, A, 5 + C + (1 if iou_aware else 0), H, W)
+    if iou_aware:
+        iou_pred = jax.nn.sigmoid(feat[:, :, -1])
+        feat = feat[:, :, :5 + C]
+    tx, ty, tw, th, tobj = (feat[:, :, 0], feat[:, :, 1], feat[:, :, 2],
+                            feat[:, :, 3], feat[:, :, 4])
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(tx) * scale_x_y
+          - (scale_x_y - 1) / 2 + gx) / W
+    by = (jax.nn.sigmoid(ty) * scale_x_y
+          - (scale_x_y - 1) / 2 + gy) / H
+    input_w = W * downsample_ratio
+    input_h = H * downsample_ratio
+    bw = jnp.exp(tw) * an[None, :, None, None, 0] / input_w
+    bh = jnp.exp(th) * an[None, :, None, None, 1] / input_h
+    imh = im[:, 0][:, None, None, None]
+    imw = im[:, 1][:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    obj = jax.nn.sigmoid(tobj)
+    if iou_aware:
+        obj = obj ** (1 - iou_aware_factor) * iou_pred ** iou_aware_factor
+    cls = jax.nn.sigmoid(feat[:, :, 5:5 + C])
+    scores = obj[:, :, None] * cls
+    conf_mask = (obj >= conf_thresh)[:, :, None]
+    scores = jnp.where(conf_mask, scores, 0.0)
+
+    def flat(v):  # [B, A, H, W] -> [B, A*H*W]
+        return v.reshape(B, A * H * W)
+
+    boxes = jnp.stack([flat(x1), flat(y1), flat(x2), flat(y2)], axis=-1)
+    sc = scores.transpose(0, 1, 3, 4, 2).reshape(B, A * H * W, C)
+    return Tensor(boxes), Tensor(sc)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference matrix_nms op; SOLOv2's parallel soft-NMS):
+    instead of the greedy sweep, every detection's score is decayed by its
+    IoU with all higher-scored detections of the same class:
+    decay = min_j f(iou_ij) / f(max_k iou_jk). Host-side output packing
+    (the result count is data-dependent), matmul-style IoU matrix math."""
+    b = np.asarray(_data(bboxes), np.float32)
+    s = np.asarray(_data(scores), np.float32)
+    B, C, N = s.shape
+    outs, indices, counts = [], [], []
+    for bi in range(B):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = s[bi, c]
+            keep = np.where(sc > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-sc[keep])][:nms_top_k]
+            bb = b[bi, order]
+            ss = sc[order]
+            x1, y1, x2, y2 = bb[:, 0], bb[:, 1], bb[:, 2], bb[:, 3]
+            off = 0.0 if normalized else 1.0
+            area = (x2 - x1 + off) * (y2 - y1 + off)
+            ix1 = np.maximum(x1[:, None], x1[None, :])
+            iy1 = np.maximum(y1[:, None], y1[None, :])
+            ix2 = np.minimum(x2[:, None], x2[None, :])
+            iy2 = np.minimum(y2[:, None], y2[None, :])
+            iw = np.maximum(ix2 - ix1 + off, 0)
+            ih = np.maximum(iy2 - iy1 + off, 0)
+            iou = iw * ih / np.maximum(
+                area[:, None] + area[None, :] - iw * ih, 1e-10)
+            iou = np.triu(iou, k=1)  # iou[i, j]: higher-scored i vs j
+            comp = iou.max(axis=0)   # det i's own max overlap upstream
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - comp[:, None] ** 2)
+                               / gaussian_sigma)
+            else:
+                decay = (1 - iou) / np.maximum(1 - comp[:, None], 1e-10)
+            decay = np.where(np.triu(np.ones_like(iou), k=1) > 0,
+                             decay, 1.0).min(axis=0)
+            new_s = ss * decay
+            ok = np.where(new_s >= post_threshold)[0]
+            for j in ok:
+                dets.append((c, new_s[j], *bb[j], bi * C * N + c * N
+                             + order[j]))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        for d in dets:
+            outs.append(d[:6])
+            indices.append(d[6])
+    out = (np.asarray(outs, np.float32).reshape(-1, 6) if outs
+           else np.zeros((0, 6), np.float32))
+    res = [Tensor(jnp.asarray(out))]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(indices, np.int64))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+    return tuple(res) if len(res) > 1 else res[0]
+
+
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=1000, keep_top_k=100, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=-1,
+                    return_index=False, name=None):
+    """Per-class greedy NMS + cross-class top-k (reference multiclass_nms3,
+    `phi/kernels/.../multiclass_nms3_kernel`): bboxes [B, N, 4], scores
+    [B, C, N]. Returns (out [M, 6] rows (label, score, x1, y1, x2, y2),
+    [index], rois_num [B]). Host-side packing like the reference CPU
+    kernel; the per-class suppression reuses the device nms."""
+    b = np.asarray(_data(bboxes), np.float32)
+    s = np.asarray(_data(scores), np.float32)
+    B, C, N = s.shape
+    outs, idxs, counts = [], [], []
+    for bi in range(B):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            sc = s[bi, c]
+            cand = np.where(sc > score_threshold)[0]
+            if cand.size == 0:
+                continue
+            cand = cand[np.argsort(-sc[cand])][:nms_top_k]
+            kept = np.asarray(nms(Tensor(jnp.asarray(b[bi, cand])),
+                                  iou_threshold=nms_threshold).numpy())
+            for j in kept:
+                gi = cand[int(j)]
+                dets.append((c, sc[gi], *b[bi, gi], bi * N + gi))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        counts.append(len(dets))
+        for d in dets:
+            outs.append(d[:6])
+            idxs.append(d[6])
+    out = (np.asarray(outs, np.float32).reshape(-1, 6) if outs
+           else np.zeros((0, 6), np.float32))
+    res = [Tensor(jnp.asarray(out))]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(idxs, np.int64))))
+    res.append(Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+    return tuple(res)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (reference generate_proposals_v2,
+    `phi/kernels/.../generate_proposals_kernel`): per image — decode
+    anchor deltas (box_coder math), clip to the image, drop tiny boxes,
+    top pre_nms_top_n by score, greedy NMS, top post_nms_top_n. Decode +
+    clip run on device; the ragged packing is host-side."""
+    sc = np.asarray(_data(scores), np.float32)       # [B, A, H, W]
+    bd = np.asarray(_data(bbox_deltas), np.float32)  # [B, A*4, H, W]
+    ims = np.asarray(_data(img_size), np.float32)    # [B, 2] (h, w)
+    an = np.asarray(_data(anchors), np.float32).reshape(-1, 4)
+    var = np.asarray(_data(variances), np.float32).reshape(-1, 4)
+    B, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    all_rois, counts = [], []
+    for bi in range(B):
+        score = sc[bi].transpose(1, 2, 0).reshape(-1)       # H*W*A
+        delta = bd[bi].reshape(A, 4, H, W).transpose(
+            2, 3, 0, 1).reshape(-1, 4)
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = var[:, 0] * delta[:, 0] * aw + acx
+        cy = var[:, 1] * delta[:, 1] * ah + acy
+        w = np.exp(np.minimum(var[:, 2] * delta[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(var[:, 3] * delta[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], axis=1)
+        imh, imw = ims[bi]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, imw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, imh - off)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        valid = np.where((ws >= min_size) & (hs >= min_size))[0]
+        order = valid[np.argsort(-score[valid])][:pre_nms_top_n]
+        if order.size == 0:
+            counts.append(0)
+            continue
+        kept = np.asarray(nms(Tensor(jnp.asarray(boxes[order])),
+                              iou_threshold=nms_thresh).numpy())
+        kept = order[kept[:post_nms_top_n]]
+        all_rois.append(boxes[kept])
+        counts.append(len(kept))
+    rois = (np.concatenate(all_rois, axis=0) if all_rois
+            else np.zeros((0, 4), np.float32))
+    out = (Tensor(jnp.asarray(rois)),)
+    if return_rois_num:
+        out = out + (Tensor(jnp.asarray(np.asarray(counts, np.int32))),)
+    return out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size=None, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1, output_channels=None,
+               name=None):
+    """Position-sensitive ROI pooling (reference psroi_pool,
+    `phi/kernels/.../psroi_pool_kernel`; R-FCN): x [B, C, H, W] with
+    C = out_c * ph * pw — output channel (i, j) bin pools its OWN channel
+    group. Implemented as bin-center bilinear sampling + average (the
+    PSROIAlign formulation — continuous sampling instead of the
+    reference's integer binning, same capability, TPU-friendly gathers)."""
+    xd = _data(x).astype(jnp.float32)
+    bx = _data(boxes).astype(jnp.float32)
+    bn = np.asarray(_data(boxes_num)).ravel()
+    if output_size is None:
+        ph, pw = int(pooled_height), int(pooled_width)
+    else:
+        ph, pw = ((output_size, output_size)
+                  if isinstance(output_size, int) else output_size)
+    B, C, H, W = xd.shape
+    out_c = C // (ph * pw)
+    batch_of = np.repeat(np.arange(len(bn)), bn)
+
+    def one(box, bidx):
+        x1, y1, x2, y2 = box * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1) / pw
+        rh = jnp.maximum(y2 - y1, 0.1) / ph
+        js, is_ = jnp.meshgrid(jnp.arange(pw, dtype=jnp.float32),
+                               jnp.arange(ph, dtype=jnp.float32))
+        cx = x1 + (js + 0.5) * rw   # [ph, pw] bin centers
+        cy = y1 + (is_ + 0.5) * rh
+        x0 = jnp.clip(jnp.floor(cx), 0, W - 1).astype(jnp.int32)
+        y0 = jnp.clip(jnp.floor(cy), 0, H - 1).astype(jnp.int32)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        fx = jnp.clip(cx, 0, W - 1) - x0
+        fy = jnp.clip(cy, 0, H - 1) - y0
+        fm = xd[bidx].reshape(out_c, ph, pw, H, W)
+        grp = fm[:, jnp.arange(ph)[:, None], jnp.arange(pw)[None, :]]
+        # grp: [out_c, ph, pw, H, W]; gather the 4 corners at each bin
+        g = lambda yy, xx: grp[:, is_.astype(jnp.int32), js.astype(jnp.int32),
+                               yy, xx]  # noqa: E731
+        v = (g(y0, x0) * (1 - fx) * (1 - fy) + g(y0, x1i) * fx * (1 - fy)
+             + g(y1i, x0) * (1 - fx) * fy + g(y1i, x1i) * fx * fy)
+        return v  # [out_c, ph, pw]
+
+    outs = [one(bx[i], int(batch_of[i])) for i in range(bx.shape[0])]
+    out = (jnp.stack(outs) if outs
+           else jnp.zeros((0, out_c, ph, pw), jnp.float32))
+    return Tensor(out)

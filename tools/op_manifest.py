@@ -32,6 +32,7 @@ REF_BACKWARD = "/root/reference/paddle/phi/ops/yaml/backward.yaml"
 
 # capability exists under a different name (reference op -> where we have it)
 ALIASES = {
+    "ftrl": "paddle.distributed.ps.SparseTable (optimizer='ftrl', the sparse FTRL-Proximal rule)",
     # collectives: functional API over mesh axes (distributed/communication.py)
     "all_gather": "paddle.distributed.all_gather",
     "all_reduce": "paddle.distributed.all_reduce",
@@ -230,8 +231,6 @@ SUBSUMED = {
 }
 
 SKIPS = {
-    "ftrl": "legacy PS optimizer (sparse FTRL); dense path covered by SGD family",
-    "dpsgd": "legacy PS differential-privacy optimizer",
     # legacy parameter-server / recommendation stack (SURVEY: defensible skip)
     "pyramid_hash": "legacy PS sparse-recommendation op",
     "tdm_child": "legacy PS tree-based recommendation",
@@ -239,23 +238,7 @@ SKIPS = {
     "rank_attention": "legacy PS recommendation",
     "batch_fc": "legacy PS recommendation",
     "match_matrix_tensor": "legacy text-matching op",
-    "cvm": "legacy PS recommendation",
-    "im2sequence": "legacy OCR sequence op",
-    "sequence_conv": "legacy LoD sequence stack",
-    "sequence_pool": "legacy LoD sequence stack",
-    "beam_search": "legacy LoD decoder; generation uses jit sampling loop",
-    "dgc": "deep gradient compression (GPU-interconnect specific)",
-    "dgc_clip_by_norm": "deep gradient compression",
-    "dgc_momentum": "deep gradient compression",
     # mobile/detection zoo: out of scope for the north-star configs
-    "generate_proposals": "two-stage detection zoo",
-    "collect_fpn_proposals": "two-stage detection zoo",
-    "matrix_nms": "detection zoo",
-    "multiclass_nms3": "detection zoo",
-    "bipartite_match": "detection zoo",
-    "box_clip": "detection zoo",
-    "psroi_pool": "detection zoo",
-    "yolo_box": "detection zoo",
     "yolo_box_head": "detection zoo",
     "yolo_box_post": "detection zoo",
     "yolo_loss": "detection zoo",
@@ -272,15 +255,8 @@ SKIPS = {
     "decode_jpeg": "host-side image decode (use PIL/np in Dataset)",
     "read_file": "host-side file read",
     # niche sequence decoders
-    "crf_decoding": "legacy CRF stack",
-    "ctc_align": "legacy CTC postprocess",
-    "chunk_eval": "legacy NER metric",
     "warprnnt": "RNN-T loss (niche; CTC covered)",
     "class_center_sample": "face-recognition sampling (niche)",
-    "add_position_encoding": "legacy transformer op; done in Python",
-    "affine_channel": "legacy detection normalization",
-    "fractional_max_pool2d": "niche pooling",
-    "fractional_max_pool3d": "niche pooling",
     "get_tensor_from_selected_rows": "SelectedRows legacy container",
     "merge_selected_rows": "SelectedRows legacy container",
 }
