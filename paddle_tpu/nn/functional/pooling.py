@@ -332,7 +332,12 @@ def _fractional_max(x, output_size, kernel_size, random_u, return_mask,
     xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
     spatial = xd.shape[2:]
     outs = _tuple(output_size, ndim)
-    u = float(random_u) if random_u is not None else 0.5
+    if random_u is None:
+        # fresh u per call, like the reference kernel without a given u —
+        # the stochastic regions ARE the regularizer (Graham 2014)
+        u = float(np.random.uniform(1e-3, 1 - 1e-3))
+    else:
+        u = float(random_u)
     if not (0 < u < 1):
         raise ValueError("random_u must be in (0, 1)")
     bounds = [_fractional_bounds(spatial[d], outs[d], u)

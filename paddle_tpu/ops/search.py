@@ -307,6 +307,10 @@ def chunk_eval(inference, label, chunk_scheme="IOB", num_chunk_types=1,
             if chunk_scheme == "IOE" and start is not None and \
                     ttype == ctype and i > 0 and int(seq[i - 1]) % npos == 1:
                 ends = True  # previous token was E: chunk closed
+            if chunk_scheme == "IOBES" and start is not None and i > 0 \
+                    and int(seq[i - 1]) < out_tag \
+                    and int(seq[i - 1]) % npos == 2:
+                ends = True  # reference ChunkEnd: prev tag E closes it
             if chunk_scheme == "plain":
                 ends = start is not None
             if ends:

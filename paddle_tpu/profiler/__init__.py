@@ -96,10 +96,69 @@ class RecordEvent:
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready handler writing a merged chrome trace (reference
+    `platform/profiler/chrometracing_logger.cc`): host op dispatches +
+    the xprof device lanes in one chrome://tracing-loadable file."""
     def handler(prof):
-        prof._export_dir = dir_name
+        import os
+
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or "worker"
+        prof.export_chrome_trace(os.path.join(dir_name, f"{name}.json"))
 
     return handler
+
+
+def _parse_device_trace(log_dir):
+    """Per-op DEVICE time from the xprof dump (VERDICT r4 item 8): the
+    latest `plugins/profile/<run>/` holds `*.trace.json.gz` whose TPU
+    lanes are processes named `/device:TPU:N` with `XLA Ops` /
+    `XLA Modules` threads (per-HLO / per-module events). Returns
+    ({event_name: [dur_seconds]}, device_busy_seconds, raw_events) —
+    empty on host-only traces (XLA:CPU compute runs in host threads)."""
+    import glob
+    import gzip
+    import json
+    import os
+
+    runs = sorted(glob.glob(os.path.join(log_dir, "plugins", "profile",
+                                         "*")))
+    if not runs:
+        return {}, 0.0, []
+    per_op = defaultdict(list)
+    module_busy = 0.0
+    raw = []
+    for tj in glob.glob(os.path.join(runs[-1], "*.trace.json.gz")):
+        try:
+            data = json.loads(gzip.open(tj).read())
+        except Exception:
+            continue
+        evs = data.get("traceEvents", [])
+        procs, threads = {}, {}
+        for e in evs:
+            if e.get("ph") == "M":
+                nm = e.get("args", {}).get("name", "")
+                if e.get("name") == "process_name":
+                    procs[e.get("pid")] = nm
+                elif e.get("name") == "thread_name":
+                    threads[(e.get("pid"), e.get("tid"))] = nm
+        for e in evs:
+            if e.get("ph") != "X":
+                continue
+            pn = procs.get(e.get("pid"), "")
+            tn = threads.get((e.get("pid"), e.get("tid")), "")
+            if not ("/device:" in pn or pn.startswith("TPU")
+                    or "XLA Ops" in tn or "XLA Modules" in tn):
+                continue
+            dur_s = float(e.get("dur", 0.0)) / 1e6
+            raw.append({"name": e.get("name", "?"), "ts": e.get("ts", 0),
+                        "dur": e.get("dur", 0.0), "lane": tn or pn})
+            if "Modules" in tn:
+                module_busy += dur_s  # whole-module span: busy, not per-op
+            else:
+                per_op[e.get("name", "?")].append(dur_s)
+    busy = module_busy or sum(sum(v) for v in per_op.values())
+    return dict(per_op), busy, raw
 
 
 def load_profiler_result(path):
@@ -123,6 +182,10 @@ class Profiler:
         self._tracing = False
         self._step_times = []
         self._last_step_t = None
+        self._records = []      # (name, end_ts, dur) for chrome export
+        self.device_events = {}  # xprof device lanes: name -> [dur_s]
+        self.device_total = 0.0
+        self._device_raw = []
 
     def start(self):
         # fresh op table per session — successive profiler runs must not
@@ -141,8 +204,11 @@ class Profiler:
         # RecordEvent bracket in every generated api, api_base.py:1356)
         from paddle_tpu.core import tensor as _core_tensor
 
-        _core_tensor._op_tracer = \
-            lambda name, dur: _op_events[name].append(dur)
+        def _trace(name, dur):
+            _op_events[name].append(dur)
+            self._records.append((name, time.perf_counter(), dur))
+
+        _core_tensor._op_tracer = _trace
         self.current_state = ProfilerState.RECORD
 
     def stop(self):
@@ -154,6 +220,9 @@ class Profiler:
 
             jax.profiler.stop_trace()
             self._tracing = False
+            # device-time attribution from the dump we just wrote
+            (self.device_events, self.device_total,
+             self._device_raw) = _parse_device_trace(self.log_dir)
         self.current_state = ProfilerState.CLOSED
         if self.on_trace_ready is not None:
             self.on_trace_ready(self)
@@ -177,12 +246,47 @@ class Profiler:
         `_build_table`): Overview / Model / Operator / UserDefined / Memory
         views with sort keys — over host op-dispatch events, RecordEvent
         brackets, and step timings."""
-        data = StatisticData(_op_events, _events, self._step_times)
+        data = StatisticData(_op_events, _events, self._step_times,
+                             device_events=self.device_events,
+                             device_total=self.device_total)
         table = build_table(data, sorted_by=sorted_by, views=views,
                             time_unit=time_unit, row_limit=row_limit,
                             op_detail=op_detail)
         print(table)
         return table
+
+    def export_chrome_trace(self, path):
+        """Write one chrome://tracing-loadable JSON merging host op
+        dispatches and the xprof device lanes (reference
+        chrometracing_logger.cc). Host and device clocks have unrelated
+        epochs, so each lane is REBASED to its own t=0 — durations and
+        within-lane ordering are exact; cross-lane alignment is
+        approximate (xprof's own viewer is the precise correlation
+        view)."""
+        import json
+        import os
+
+        evs = [
+            {"name": "process_name", "ph": "M", "pid": 0,
+             "args": {"name": "host: op dispatch"}},
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": "device (from xprof)"}},
+        ]
+        host0 = min(((end - dur) for _, end, dur in self._records),
+                    default=0.0)
+        for name, end_ts, dur in self._records:
+            evs.append({"name": name, "ph": "X", "pid": 0, "tid": 0,
+                        "ts": (end_ts - dur - host0) * 1e6,
+                        "dur": dur * 1e6, "cat": "op"})
+        dev0 = min((e["ts"] for e in self._device_raw), default=0.0)
+        for e in self._device_raw:
+            evs.append({"name": e["name"], "ph": "X", "pid": 1, "tid": 0,
+                        "ts": e["ts"] - dev0, "dur": e["dur"],
+                        "cat": "device", "args": {"lane": e["lane"]}})
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": evs}, f)
+        return path
 
     def __enter__(self):
         self.start()

@@ -86,12 +86,39 @@ _PHASES = ("dataloader", "forward", "backward", "optimizer", "other")
 
 
 class StatisticData:
-    """Aggregated views over (op_events, user_events, step_times)."""
+    """Aggregated views over (op_events, user_events, step_times[,
+    device_events]). device_events come from the xprof dump's device
+    lanes (profiler._parse_device_trace) — per-XLA-op durations that fill
+    the reference's GPU-total column."""
 
-    def __init__(self, op_events, user_events, step_times):
+    def __init__(self, op_events, user_events, step_times,
+                 device_events=None, device_total=0.0):
         self.ops = self._agg(op_events)
         self.user = self._agg(user_events)
         self.step_times = list(step_times)
+        self.device = self._agg(device_events or {})
+        self.device_total = device_total
+
+    def device_for_op(self, op_name):
+        """Device total attributed to a host op: the eager waist jits each
+        op, so its XLA module lane is named `jit_<op>...` (exact op-name
+        events match too — fused kernels keep the root op's name). The
+        match is BOUNDARY-anchored: `jit_relu` must not absorb
+        `jit_relu6`'s time."""
+        def anchored(base, stem):
+            if base == stem:
+                return True
+            if not base.startswith(stem):
+                return False
+            nxt = base[len(stem)]
+            return not (nxt.isalnum() or nxt == "_")
+
+        total = 0.0
+        for name, st in self.device.items():
+            base = name.split("(")[0]
+            if anchored(base, op_name) or anchored(base, f"jit_{op_name}"):
+                total += st.total
+        return total
 
     @staticmethod
     def _agg(events):
@@ -184,7 +211,12 @@ def build_table(data: StatisticData, sorted_by=SortedKeys.CPUTotal,
                 ("UserDefined events",
                  sum(s.calls for s in data.user.values()),
                  _t(sum(s.total for s in data.user.values()), scale),
-                 "-")]
+                 "-"),
+                ("Device busy (xprof)",
+                 sum(s.calls for s in data.device.values()),
+                 _t(data.device_total, scale),
+                 _t(data.device_total / max(len(data.step_times), 1),
+                    scale))]
         blocks.append(_table(
             f"Overview Summary (time unit: {time_unit})",
             ("Event", "Calls", "Total", "Avg/Step"), rows))
@@ -199,11 +231,16 @@ def build_table(data: StatisticData, sorted_by=SortedKeys.CPUTotal,
 
     if want(SummaryView.OperatorView) and op_detail and data.ops:
         stats = data.sorted_ops(sorted_by)[:row_limit]
+        rows = []
+        for s, base in zip(stats, _stat_rows(stats, total_host, scale)):
+            dv = data.device_for_op(s.name)
+            rows.append(base[:6] + (_t(dv, scale) if dv else "-",)
+                        + base[6:])
         blocks.append(_table(
-            f"Operator Summary (host dispatch, time unit: {time_unit}, "
-            f"sorted by {sorted_by.name})",
-            ("Operator", "Calls", "Total", "Avg", "Max", "Min", "Ratio"),
-            _stat_rows(stats, total_host, scale)))
+            f"Operator Summary (host dispatch + device, time unit: "
+            f"{time_unit}, sorted by {sorted_by.name})",
+            ("Operator", "Calls", "Total", "Avg", "Max", "Min",
+             "DevTotal", "Ratio"), rows))
 
     if want(SummaryView.UDFView) and data.user:
         stats = sorted(data.user.values(), key=lambda s: -s.total)[:row_limit]
@@ -228,8 +265,18 @@ def build_table(data: StatisticData, sorted_by=SortedKeys.CPUTotal,
             pass
 
     if want(SummaryView.KernelView) or want(SummaryView.DeviceView):
-        blocks.append("Device kernel timelines: open the xprof dump in "
-                      "log_dir with tensorboard (XLA fuses ops; per-kernel "
-                      "device attribution lives there).")
+        if data.device:
+            stats = sorted(data.device.values(),
+                           key=lambda s: -s.total)[:row_limit]
+            blocks.append(_table(
+                f"Kernel Summary (device, from xprof, time unit: "
+                f"{time_unit})",
+                ("Kernel", "Calls", "Total", "Avg", "Max", "Min", "Ratio"),
+                _stat_rows(stats, data.device_total, scale)))
+        else:
+            blocks.append(
+                "Device kernel timelines: no device lanes in this trace "
+                "(host-only run); on TPU the xprof dump feeds the Kernel "
+                "Summary and the Operator DevTotal column.")
 
     return "\n\n".join(blocks)

@@ -481,10 +481,13 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
     A = len(anchors) // 2
     C = int(class_num)
     an = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
-    feat = xd.reshape(B, A, 5 + C + (1 if iou_aware else 0), H, W)
     if iou_aware:
-        iou_pred = jax.nn.sigmoid(feat[:, :, -1])
-        feat = feat[:, :, :5 + C]
+        # reference layout (yolo_box_util.h GetIoUIndex): the A iou
+        # channels come FIRST, then the A*(5+C) conv channels
+        iou_pred = jax.nn.sigmoid(xd[:, :A])
+        feat = xd[:, A:].reshape(B, A, 5 + C, H, W)
+    else:
+        feat = xd.reshape(B, A, 5 + C, H, W)
     tx, ty, tw, th, tobj = (feat[:, :, 0], feat[:, :, 1], feat[:, :, 2],
                             feat[:, :, 3], feat[:, :, 4])
     gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
@@ -592,7 +595,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
 
 def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
                     nms_top_k=1000, keep_top_k=100, nms_threshold=0.3,
-                    normalized=True, nms_eta=1.0, background_label=-1,
+                    normalized=True, nms_eta=1.0, background_label=0,
                     return_index=False, name=None):
     """Per-class greedy NMS + cross-class top-k (reference multiclass_nms3,
     `phi/kernels/.../multiclass_nms3_kernel`): bboxes [B, N, 4], scores

@@ -667,8 +667,6 @@ class TestR5OpTail:
         assert 0 in ids_f.numpy()
         assert -1.0 in np.round(sc_f.numpy(), 5)
         # decode backtracks parents
-        step_ids = paddle.to_tensor(np.array(
-            [[[5, 6]], [[7, 8]]], "int64").transpose(0, 2, 1))
         step_ids = paddle.to_tensor(np.array([[[5, 6]], [[7, 8]]], "int64"))
         parents = paddle.to_tensor(np.array([[[0, 1]], [[1, 0]]], "int64"))
         seqs = paddle.beam_search_decode(step_ids, parents).numpy()
@@ -870,3 +868,40 @@ def test_beam_search_remap_respects_finished():
     assert len(fin) == 1 and i[fin[0]] == 0, (i, s)
     live = np.where(np.isclose(s, -2.2))[0]
     assert len(live) == 1 and i[live[0]] == 9 and p[live[0]] == 1
+
+
+def test_r5_review_semantics_fixes():
+    """Review-driven semantics checks: yolo_box iou-aware channel layout,
+    IOBES back-to-back chunks, anchored device-time attribution."""
+    # iou_aware: A iou channels FIRST (reference GetIoUIndex), then conv
+    rng2 = np.random.default_rng(5)
+    A, C, H, W = 2, 1, 2, 2
+    conv = rng2.normal(size=(1, A * (5 + C), H, W)).astype("float32")
+    x_plain = paddle.to_tensor(conv)
+    iou_ch = np.full((1, A, H, W), 50.0, "float32")  # sigmoid -> 1.0
+    x_aware = paddle.to_tensor(np.concatenate([iou_ch, conv], axis=1))
+    img = paddle.to_tensor(np.array([[16., 16]], "float32"))
+    kw = dict(anchors=[4, 4, 8, 8], class_num=C, downsample_ratio=8)
+    b0, s0 = paddle.vision.ops.yolo_box(x_plain, img, **kw)
+    b1, s1 = paddle.vision.ops.yolo_box(x_aware, img, iou_aware=True,
+                                        iou_aware_factor=0.0, **kw)
+    # factor 0 + iou==1: scores and boxes must equal the plain decode
+    np.testing.assert_allclose(b1.numpy(), b0.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(s1.numpy(), s0.numpy(), rtol=1e-4)
+
+    # IOBES: E closes the chunk — [B0 E0 B0 E0] is TWO chunks
+    lab = np.array([[0, 2, 0, 2]], "int64")  # B0=0 I0=1 E0=2 S0=3
+    p, r, f1, ni, nl, nc = paddle.chunk_eval(
+        paddle.to_tensor(lab), paddle.to_tensor(lab),
+        chunk_scheme="IOBES", num_chunk_types=1)
+    assert int(nl.numpy()[0]) == 2 and int(nc.numpy()[0]) == 2
+
+    # anchored device attribution: relu must not absorb relu6
+    from paddle_tpu.profiler.profiler_statistic import StatisticData
+
+    data = StatisticData({"relu": [0.001], "relu6": [0.001]}, {}, [],
+                         device_events={"jit_relu": [1.0],
+                                        "jit_relu6": [2.0]},
+                         device_total=3.0)
+    np.testing.assert_allclose(data.device_for_op("relu"), 1.0)
+    np.testing.assert_allclose(data.device_for_op("relu6"), 2.0)

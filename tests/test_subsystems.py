@@ -780,3 +780,98 @@ def test_int8_ptq_predictor(tmp_path):
     with pytest.raises(ValueError, match="calib_reader"):
         paddle.jit.save(m, str(tmp_path / "bad"), input_spec=spec,
                         quantize="int8_ptq")
+
+
+def _write_synthetic_xprof(log_dir, run="2026_01_01_00_00_00"):
+    """A minimal xprof-format trace.json.gz with TPU-style device lanes."""
+    import gzip
+    import json
+
+    d = os.path.join(log_dir, "plugins", "profile", run)
+    os.makedirs(d, exist_ok=True)
+    evs = [
+        {"ph": "M", "pid": 9, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "pid": 9, "tid": 1, "name": "thread_name",
+         "args": {"name": "XLA Ops"}},
+        {"ph": "M", "pid": 9, "tid": 2, "name": "thread_name",
+         "args": {"name": "XLA Modules"}},
+        {"ph": "M", "pid": 7, "name": "process_name",
+         "args": {"name": "/host:CPU"}},
+        {"ph": "M", "pid": 7, "tid": 3, "name": "thread_name",
+         "args": {"name": "python"}},
+        # device per-op lanes (us)
+        {"ph": "X", "pid": 9, "tid": 1, "name": "jit_matmul", "ts": 0,
+         "dur": 700.0},
+        {"ph": "X", "pid": 9, "tid": 1, "name": "jit_matmul", "ts": 800,
+         "dur": 300.0},
+        {"ph": "X", "pid": 9, "tid": 1, "name": "fusion.1", "ts": 1200,
+         "dur": 100.0},
+        # whole-module lane: busy time, not per-op
+        {"ph": "X", "pid": 9, "tid": 2, "name": "jit_step", "ts": 0,
+         "dur": 1500.0},
+        # host lane must be ignored
+        {"ph": "X", "pid": 7, "tid": 3, "name": "isinstance", "ts": 0,
+         "dur": 9999.0},
+    ]
+    with gzip.open(os.path.join(d, "host.trace.json.gz"), "wt") as f:
+        json.dump({"traceEvents": evs}, f)
+
+
+def test_profiler_device_time_attribution(tmp_path):
+    """Per-op DEVICE time from the xprof dump (VERDICT r4 item 8): the
+    parser reads the TPU lanes, the Operator table gains a DevTotal
+    column, and the Kernel Summary matches the reference's GPU-total
+    column."""
+    from paddle_tpu import profiler as prof_mod
+    from paddle_tpu.profiler import Profiler, SummaryView
+    from paddle_tpu.profiler.profiler_statistic import (StatisticData,
+                                                        build_table)
+
+    _write_synthetic_xprof(str(tmp_path))
+    dev, busy, raw = prof_mod._parse_device_trace(str(tmp_path))
+    assert set(dev) == {"jit_matmul", "fusion.1"}
+    np.testing.assert_allclose(sum(dev["jit_matmul"]), 1e-3)  # 1000us
+    np.testing.assert_allclose(busy, 1.5e-3)  # module lane
+    assert all(e["name"] != "isinstance" for e in raw)  # host lane dropped
+
+    data = StatisticData({"matmul": [0.002, 0.001]}, {}, [0.01],
+                         device_events=dev, device_total=busy)
+    np.testing.assert_allclose(data.device_for_op("matmul"), 1e-3)
+    table = build_table(data)
+    assert "DevTotal" in table
+    assert "Kernel Summary" in table and "jit_matmul" in table
+    assert "Device busy (xprof)" in table
+
+    # live session on this backend: host-only trace -> graceful fallback
+    p = Profiler(log_dir=str(tmp_path / "live"))
+    p.start()
+    (paddle.ones([8, 8]) @ paddle.ones([8, 8])).numpy()
+    p.step()
+    p.stop()
+    out = p.summary(views=[SummaryView.OperatorView,
+                           SummaryView.KernelView])
+    assert "matmul" in out
+
+
+def test_profiler_chrome_trace_export(tmp_path):
+    """export_chrome_tracing writes one chrome://tracing-loadable file
+    merging host op dispatches and device lanes (reference
+    chrometracing_logger.cc)."""
+    import json
+
+    from paddle_tpu.profiler import Profiler, export_chrome_tracing
+
+    out_dir = str(tmp_path / "chrome")
+    p = Profiler(log_dir=str(tmp_path / "log"),
+                 on_trace_ready=export_chrome_tracing(out_dir, "w0"))
+    p.start()
+    (paddle.ones([4, 4]) + paddle.ones([4, 4])).numpy()
+    p.stop()
+    path = os.path.join(out_dir, "w0.json")
+    assert os.path.exists(path)
+    trace = json.load(open(path))
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "add" in names  # host op dispatch
+    cats = {e.get("cat") for e in trace["traceEvents"] if e.get("ph") == "X"}
+    assert "op" in cats
