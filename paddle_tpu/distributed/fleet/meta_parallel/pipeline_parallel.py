@@ -1,23 +1,27 @@
-"""PipelineParallel trainer (1F1B semantics).
+"""PipelineParallel trainer: a REAL eager 1F1B scheduler.
 
 Reference: `python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py`
 — `train_batch` (:940) splits the batch into micro-batches and runs
 `forward_backward_pipeline` (:684): 1F1B warmup/steady/cooldown with p2p
 isend/irecv at stage edges (`pp_utils/p2p_communication.py:573`).
 
-TPU-native: 1F1B exists to bound activation memory *per rank process*; its
-loss/grad math is exactly gradient accumulation over micro-batches. Under a
-single controller the eager trainer runs micro-batches through all stages in
-order and accumulates grads — bit-identical losses to the reference schedule
-— while the *performance* schedules (stage-sharded scan + collective-permute
-over the 'pp' mesh axis, riding ICI) live in the compiled paths:
-`paddle_tpu.distributed.hybrid_engine.HybridParallelEngine` (flagship
-Llama, gpipe/1f1b/VPP/zero-bubble) and
-`paddle_tpu.distributed.pipeline_engine.PipelineEngine` (any
-PipelineLayer). Activation memory in eager is bounded by recompute_interval.
+TPU-native: the *performance* schedules (stage-sharded scan +
+collective-permute over the 'pp' mesh axis, riding ICI) live in the
+compiled paths (`HybridParallelEngine`, `PipelineEngine`). This eager
+trainer exists for what the reference's eager mode is for — DEBUGGING the
+schedule mechanics — so it runs the actual per-stage state machine, not
+just gradient accumulation (the r3/r4 shape of this file): stage-local
+segments exchange detached boundary activations forward and boundary
+grads backward through queues, each stage obeys the 1F1B in-flight bound
+(<= S - s stashed activations, the schedule's entire memory point, which
+`max_inflight` exposes for inspection), and backward re-enters the stage
+subgraph via `autograd.backward(outputs, output_grads)`. Loss/grad math
+is identical to the reference schedule; per-stage order is too.
 """
 
 from __future__ import annotations
+
+from collections import deque
 
 import numpy as np
 
@@ -80,25 +84,120 @@ class PipelineParallel:
         return [data] * self.accumulate_steps
 
     def forward_backward_pipeline(self, data, scaler=None):
-        """Micro-batch loop == 1F1B loss/grad math (reference :684)."""
+        """The 1F1B state machine (reference :684): per-stage warmup /
+        steady 1F1B / cooldown over boundary-activation queues, with the
+        schedule's in-flight bound enforced (stage s stashes at most
+        S - s activations)."""
+        from paddle_tpu import autograd as _autograd
+
         inputs, labels = data
+        M = self.accumulate_steps
+        S = self.num_stages
         micro_inputs = self._split_micro(inputs)
         micro_labels = self._split_micro(labels)
-        total = None
-        for mi, ml in zip(micro_inputs, micro_labels):
-            out = self._layers(mi) if not isinstance(mi, (tuple, list)) \
-                else self._layers(*mi)
-            loss_fn = getattr(self._layers, "_loss_fn", None)
-            if loss_fn is None:
-                raise RuntimeError("PipelineLayer needs loss_fn for train_batch")
-            loss = loss_fn(out, ml)
-            loss = loss / self.accumulate_steps
-            if scaler is not None:
-                scaled = scaler.scale(loss)
-                scaled.backward()
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        if loss_fn is None:
+            raise RuntimeError("PipelineLayer needs loss_fn for train_batch")
+        if not hasattr(self._layers, "stage_forward"):
+            raise RuntimeError("PipelineParallel needs a PipelineLayer "
+                               "(stage segments)")
+
+        in_q = [deque() for _ in range(S)]    # boundary acts from s-1
+        grad_q = [deque() for _ in range(S)]  # boundary grads from s+1
+        stash = [deque() for _ in range(S)]   # (boundary_in, out) per mb
+        fwd_done = [0] * S
+        bwd_done = [0] * S
+        self.max_inflight = [0] * S
+        losses = []
+        # warmup depth: stage s runs S-1-s forwards before its first
+        # backward (reference :684's num_warmup_microbatches)
+        warmup = [min(S - 1 - s, M) for s in range(S)]
+
+        def as_tuple(x):
+            return x if isinstance(x, tuple) else (x,)
+
+        def do_fwd(s):
+            mb = fwd_done[s]
+            if s == 0:
+                x = micro_inputs[mb]
+                xs = tuple(x) if isinstance(x, (tuple, list)) else (x,)
+                boundary = None
             else:
-                loss.backward()
-            total = loss if total is None else total + loss.detach()
+                xs = as_tuple(in_q[s].popleft())
+                # the stage boundary: detached leaves that collect the
+                # incoming grad for the p2p hop backward
+                xs = tuple(t.detach() for t in xs)
+                for t in xs:
+                    t.stop_gradient = False
+                boundary = xs
+            out = self._layers.stage_forward(s, *xs)
+            fwd_done[s] += 1
+            if s == S - 1:
+                loss = loss_fn(out, micro_labels[mb]) / M
+                losses.append(loss)
+                stash[s].append((boundary, loss))
+            else:
+                stash[s].append((boundary, out))
+                outs = as_tuple(out)
+                nxt = tuple(t.detach() for t in outs)
+                in_q[s + 1].append(nxt if len(nxt) > 1 else nxt[0])
+            self.max_inflight[s] = max(self.max_inflight[s],
+                                       len(stash[s]))
+
+        def do_bwd(s):
+            boundary, out = stash[s].popleft()
+            if s == S - 1:
+                if scaler is not None:
+                    scaler.scale(out).backward()
+                else:
+                    out.backward()
+            else:
+                gs = as_tuple(grad_q[s].popleft())
+                _autograd.backward(list(as_tuple(out)), list(gs))
+            bwd_done[s] += 1
+            if s > 0:
+                # a pass-through boundary tensor the loss doesn't depend on
+                # gets a ZERO grad, like the reference's p2p of zeroed
+                # buffers — None would crash the upstream backward
+                import jax.numpy as jnp
+
+                grads = tuple(
+                    t.grad if t.grad is not None
+                    else Tensor(jnp.zeros_like(t._data))
+                    for t in boundary)
+                grad_q[s - 1].append(grads if len(grads) > 1
+                                     else grads[0])
+
+        def can_fwd(s):
+            if fwd_done[s] >= M:
+                return False
+            return s == 0 or len(in_q[s]) > 0
+
+        def can_bwd(s):
+            if bwd_done[s] >= fwd_done[s] or not stash[s]:
+                return False
+            return s == S - 1 or len(grad_q[s]) > 0
+
+        while any(b < M for b in bwd_done):
+            progressed = False
+            for s in range(S):
+                if fwd_done[s] < warmup[s] and can_fwd(s):
+                    do_fwd(s)          # warmup: forwards only
+                    progressed = True
+                elif can_bwd(s):
+                    do_bwd(s)          # steady: backward has priority
+                    progressed = True
+                elif can_fwd(s) and len(stash[s]) < S - s:
+                    do_fwd(s)          # 1F1B in-flight bound
+                    progressed = True
+            if not progressed:
+                raise RuntimeError(
+                    f"pipeline schedule deadlock: fwd={fwd_done} "
+                    f"bwd={bwd_done}")
+
+        total = losses[0].detach()
+        for l in losses[1:]:
+            total = total + l.detach()
         self.total_loss = total
         return total
 
@@ -140,7 +239,8 @@ class PipelineParallelWithInterleave(PipelineParallel):
     the reference enforces (accumulate_steps % num_stages, chunk count
     dividing the layer segments). The loss/grad math itself is inherited
     micro-batch accumulation — chunk interleaving is realized on the mesh by
-    the compiled schedule, not re-enacted per-op here.
+    the compiled schedule; the eager loss/grad math (the inherited 1F1B
+    state machine) is chunk-order independent.
     """
 
     schedule = "interleave"
@@ -165,6 +265,6 @@ class PipelineParallelWithInterleave(PipelineParallel):
                 f"multiple of num_model_chunks ({self.num_model_chunks})")
 
     def forward_backward_pipeline(self, data, scaler=None):
-        # same accumulation math; chunk interleaving is a per-rank execution
+        # same 1F1B machinery; chunk interleaving is a per-rank execution
         # order concern that the compiled schedule realizes on the mesh
         return super().forward_backward_pipeline(data, scaler)

@@ -126,11 +126,21 @@ class PipelineLayer(nn.Layer):
         return self._num_stages
 
     def stage_forward(self, stage_id, *args):
+        """One stage's segment (the eager 1F1B scheduler's unit of work),
+        honoring recompute_interval exactly like forward() — the eager
+        trainer's activation-memory bound rides on it."""
         start, end = self.segments[stage_id]
         x = args
         for i in range(start, end):
             fn = self.run_function[i]
-            x = fn(*x) if isinstance(x, tuple) else fn(x)
+            if self._recompute_interval > 0 and \
+                    i % self._recompute_interval == 0 and i > 0:
+                from paddle_tpu.distributed.fleet.recompute import recompute
+
+                x = (recompute(fn, *x) if isinstance(x, tuple)
+                     else recompute(fn, x))
+            else:
+                x = fn(*x) if isinstance(x, tuple) else fn(x)
         return x
 
     def forward(self, *args):

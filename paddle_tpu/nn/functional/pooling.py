@@ -333,9 +333,15 @@ def _fractional_max(x, output_size, kernel_size, random_u, return_mask,
     spatial = xd.shape[2:]
     outs = _tuple(output_size, ndim)
     if random_u is None:
-        # fresh u per call, like the reference kernel without a given u —
-        # the stochastic regions ARE the regularizer (Graham 2014)
-        u = float(np.random.uniform(1e-3, 1 - 1e-3))
+        # fresh u per eager call from the FRAMEWORK stream (paddle.seed
+        # reproducible) — the stochastic regions ARE the regularizer
+        # (Graham 2014). Note: under jit the draw happens at trace time,
+        # so compiled steps reuse one u; pass random_u explicitly to
+        # control it per step.
+        from paddle_tpu.framework import random as _frng
+
+        u = float(jax.random.uniform(_frng.next_key(), (),
+                                     minval=1e-3, maxval=1 - 1e-3))
     else:
         u = float(random_u)
     if not (0 < u < 1):

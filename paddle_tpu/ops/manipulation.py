@@ -518,7 +518,7 @@ def shape(input, name=None):
 
 
 def sequence_pool(x, pool_type=None, lengths=None, pad_value=0.0,
-                  is_test=False, pooltype="SUM", name=None):
+                  is_test=False, pooltype="AVERAGE", name=None):
     """Pool each sequence to one vector (reference sequence_pool op,
     `phi/kernels/funcs/sequence_pooling.cc`). The reference packs ragged
     sequences with LoD; here x is PADDED [B, T, D] with `lengths` [B]

@@ -539,7 +539,7 @@ def dgc_momentum(param, grad, velocity, learning_rate=0.001,
                  nranks_tensor=None, mu=0.9, use_nesterov=False,
                  regularization_method="", regularization_coeff=0.0,
                  multi_precision=False, rescale_grad=1.0,
-                 rampup_begin_step=0.0, current_step=0.0, name=None):
+                 rampup_begin_step=-1.0, current_step=0.0, name=None):
     """DGC's gated momentum (reference dgc_momentum op): before the DGC
     rampup begins the update is plain momentum; afterwards the momentum
     accumulation happens inside dgc() itself, so this op passes grads
@@ -572,7 +572,7 @@ def dgc_momentum(param, grad, velocity, learning_rate=0.001,
 
 def dgc(u, v, grad, param=None, current_step=1.0, nranks=1,
         m=0.9, use_nesterov=False, sparsity=(), rampup_begin_step=0.0,
-        rampup_step=1.0, regular_coeff=0.0, regular_type=0,
+        rampup_step=0.0, regular_coeff=0.0, regular_type=0,
         ratio=0.001, name=None):
     """Deep gradient compression (reference dgc op, Lin et al. 2018 —
     public recipe): momentum-corrected top-k gradient sparsification with
@@ -618,7 +618,7 @@ def dgc(u, v, grad, param=None, current_step=1.0, nranks=1,
             _T(mask.reshape(shape)))
 
 
-def dpsgd(param, grad, learning_rate=0.01, clip=1.0, batch_size=1.0,
+def dpsgd(param, grad, learning_rate=0.01, clip=10.0, batch_size=16.0,
           sigma=1.0, seed=0, name=None):
     """Differentially-private SGD update (reference dpsgd op): per-batch
     gradient L2-clip to `clip`, Gaussian noise sigma*clip, then SGD."""

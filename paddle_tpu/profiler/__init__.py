@@ -188,9 +188,14 @@ class Profiler:
         self._device_raw = []
 
     def start(self):
-        # fresh op table per session — successive profiler runs must not
-        # mix per-op stats (user RecordEvents keep their own lifetime)
+        # fresh op/export/device tables per session — successive profiler
+        # runs must not mix per-op stats or chrome-trace events (user
+        # RecordEvents keep their own lifetime)
         _op_events.clear()
+        self._records = []
+        self.device_events = {}
+        self.device_total = 0.0
+        self._device_raw = []
         self._last_step_t = time.perf_counter()
         if not self.timer_only:
             import jax

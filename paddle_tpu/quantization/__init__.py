@@ -138,7 +138,7 @@ def weight_quantize(x, algo="weight_only_int8", arch=None,
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8",
-                      out_dtype="float32", arch=None,
+                      out_dtype="float16", arch=None,
                       group_size=-1, name=None):
     if group_size not in (-1, None):
         raise NotImplementedError(

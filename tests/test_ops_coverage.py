@@ -905,3 +905,21 @@ def test_r5_review_semantics_fixes():
                          device_total=3.0)
     np.testing.assert_allclose(data.device_for_op("relu"), 1.0)
     np.testing.assert_allclose(data.device_for_op("relu6"), 2.0)
+
+
+def test_op_schema_default_conformance():
+    """Default-VALUE conformance against ops.yaml (r5: the drift class
+    signature-name conformance can't catch — a wrapper silently shipping a
+    different default). Divergences must be audited entries in
+    _DEFAULT_DIVERGENCES with a reference-python justification."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "op_schema", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "op_schema.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    checked, violations = m.check_default_conformance()
+    assert checked >= 280, checked
+    assert not violations, violations

@@ -242,3 +242,136 @@ def main():
 
 if __name__ == "__main__":
     sys.exit(main())
+
+
+# -- default-value conformance (r5: catches the drift VERDICT flagged that
+# -- signature-name conformance cannot — a wrapper silently shipping a
+# -- different default than the reference schema) ----------------------------
+
+# intended divergences from the yaml KERNEL default, each because the
+# reference's own PYTHON api overrides it (we conform to the python
+# surface). op -> {arg: why}
+_DEFAULT_DIVERGENCES = {
+    # python-surface defaults that intentionally override the kernel yaml
+    # (verified against /root/reference/python/paddle/**):
+    "affine_channel": {"data_layout": "python api uses NCHW"},
+    "conv3d_transpose": {"data_format": "python api NCDHW (yaml says NCHW)"},
+    "dgc": {"use_nesterov": "DGCMomentumOptimizer defaults nesterov False"},
+    "edit_distance": {"normalized": "F.edit_distance normalized=True"},
+    "flatten": {"start_axis": "paddle.flatten(0, -1) full-flatten default",
+                "stop_axis": "paddle.flatten(0, -1)"},
+    "fractional_max_pool2d": {"return_mask": "F api returns value only"},
+    "fractional_max_pool3d": {"return_mask": "F api returns value only"},
+    "generate_proposals": {"pixel_offset":
+                           "vision.ops.generate_proposals=False"},
+    "hardsigmoid": {"slope": "F.hardsigmoid slope=1/6"},
+    "identity_loss": {"reduction": "python api takes the string form"},
+    "label_smooth": {"epsilon": "F.label_smooth epsilon=0.1"},
+    "leaky_relu": {"negative_slope": "F.leaky_relu 0.01"},
+    "nanmedian": {"keepdim": "paddle.nanmedian keepdim=False"},
+    "prior_box": {"aspect_ratios": "vision.ops.prior_box [1.0]",
+                  "flip": "vision.ops.prior_box False",
+                  "clip": "vision.ops.prior_box False"},
+    "roi_align": {"aligned": "vision.ops.roi_align aligned=True"},
+    "unique_consecutive": {"dtype": "python api indexes default int64"},
+}
+
+
+def _parse_yaml_default(val):
+    if val is None:
+        return None
+    v = str(val).strip()
+    if v in ("true", "True"):
+        return True
+    if v in ("false", "False"):
+        return False
+    if (v.startswith('"') and v.endswith('"')) or \
+            (v.startswith("'") and v.endswith("'")):
+        return v[1:-1]
+    if v.startswith("{"):
+        inner = v.strip("{}").strip()
+        if not inner:
+            return ()
+        return tuple(_parse_yaml_default(p) for p in inner.split(","))
+    if v.startswith("DataType::"):
+        return v.split("::", 1)[1].lower()  # DataType::INT64 == 'int64'
+    if "/" in v:  # simple fraction literals like 1.0f/3
+        num, _, den = v.partition("/")
+        try:
+            return (float(num.rstrip("f").strip("'\""))
+                    / float(den.rstrip("f").strip("'\"")))
+        except ValueError:
+            pass
+    try:
+        if any(c in v for c in (".", "e", "E")) or v.endswith("f"):
+            return float(v.rstrip("f"))
+        return int(v, 0)
+    except ValueError:
+        return v
+
+
+def _defaults_equal(yaml_v, py_v):
+    if py_v is inspect.Parameter.empty:
+        return True  # required python arg: caller must pass it — no drift
+    if py_v is None:
+        return True  # None sentinel: resolved inside the wrapper
+    if isinstance(yaml_v, bool) or isinstance(py_v, bool):
+        return bool(yaml_v) == bool(py_v)
+    if isinstance(yaml_v, (int, float)) and isinstance(py_v, (int, float)):
+        return abs(float(yaml_v) - float(py_v)) < 1e-12
+    if isinstance(yaml_v, tuple):
+        try:
+            return tuple(py_v or ()) == yaml_v
+        except TypeError:
+            return False
+    if isinstance(yaml_v, str) and isinstance(py_v, str):
+        # kernel enums are UPPER, the python api lowercase ('SUM' == 'sum')
+        return yaml_v.lower() == py_v.lower()
+    return yaml_v == py_v
+
+
+def check_default_conformance(schemas=None, verbose=False):
+    """For every implemented op: where the yaml attr has a default AND our
+    python parameter of the same (equiv) name has a CONCRETE default, the
+    two must agree (modulo the audited _DEFAULT_DIVERGENCES)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import op_manifest
+
+    schemas = schemas or load_schemas()
+    violations = []
+    checked = 0
+    for name, schema in sorted(schemas.items()):
+        status, where = op_manifest.resolve(name, paddle, F)
+        if status != "implemented":
+            continue
+        fn = _find_callable(where)
+        if fn is None:
+            continue
+        try:
+            params = inspect.signature(fn).parameters
+        except (TypeError, ValueError):
+            continue
+        kernel_only = _KERNEL_ONLY.get(name, set())
+        allowed = _DEFAULT_DIVERGENCES.get(name, {})
+        for typ, aname, default in schema.attr_args:
+            if default is None or aname in kernel_only or aname in allowed:
+                continue
+            pname = next((c for c in _NAME_EQUIV.get(aname, (aname,))
+                          if c in params), None)
+            if pname is None:
+                continue
+            yv = _parse_yaml_default(default)
+            pv = params[pname].default
+            checked += 1
+            if not _defaults_equal(yv, pv):
+                violations.append((name, aname, repr(yv), repr(pv)))
+                if verbose:
+                    print(f"{name}.{aname}: yaml={yv!r} python={pv!r}")
+    return checked, violations
