@@ -47,6 +47,7 @@ from paddle_tpu.ops.manipulation import *  # noqa: F401,F403
 from paddle_tpu.ops.linalg import *  # noqa: F401,F403
 from paddle_tpu.ops.logic import *  # noqa: F401,F403
 from paddle_tpu.ops.search import *  # noqa: F401,F403
+from paddle_tpu.ops.legacy_ps import *  # noqa: F401,F403
 
 from paddle_tpu.core import ops_patch as _ops_patch
 

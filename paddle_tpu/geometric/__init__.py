@@ -135,3 +135,166 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     out_nodes = np.asarray(sorted(mapping, key=mapping.get), np.int64)
     return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(reindex_dst)),
             Tensor(jnp.asarray(out_nodes)))
+
+
+# -- graph neighbourhood sampling (r5 op tail; reference
+# -- `phi/kernels/cpu/graph_sample_neighbors_kernel.cc` etc.) ---------------
+
+
+def _np1d(t, dtype):
+    """Any tensor-like -> flat numpy array (one unwrap idiom for all
+    three samplers)."""
+    import numpy as np
+
+    return np.asarray(getattr(t, "_data", t), dtype).reshape(-1)
+
+
+def _csc(row, colptr):
+    import numpy as np
+
+    return _np1d(row, np.int64), _np1d(colptr, np.int64)
+
+
+def _check_eids(eids, return_eids):
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True requires eids (the edge-id "
+                         "tensor aligned with `row`)")
+
+
+def graph_sample_neighbors(row, colptr, x, eids=None, perm_buffer=None,
+                           sample_size=-1, return_eids=False,
+                           flag_perm_buffer=False, name=None):
+    """Uniform neighbour sampling on a CSC graph (reference
+    graph_sample_neighbors / python `geometric.sample_neighbors`): for
+    each node in x, draw up to sample_size neighbours from
+    row[colptr[n]:colptr[n+1]]. Host-side (dynamic output), like the
+    reference CPU kernel. Returns (out, out_count[, out_eids])."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    r, c = _csc(row, colptr)
+    _check_eids(eids, return_eids)
+    xs = _np1d(x, np.int64)
+    ev = _np1d(eids, np.int64) if eids is not None else None
+    rng = np.random.default_rng()
+    outs, counts, oeids = [], [], []
+    for n in xs:
+        lo, hi = int(c[n]), int(c[n + 1])
+        deg = hi - lo
+        if sample_size in (-1, None) or deg <= sample_size:
+            sel = np.arange(lo, hi)
+        else:
+            sel = lo + rng.choice(deg, size=sample_size, replace=False)
+        outs.append(r[sel])
+        counts.append(len(sel))
+        if return_eids and ev is not None:
+            oeids.append(ev[sel])
+    out = (np.concatenate(outs) if outs else np.zeros(0, np.int64))
+    res = (Tensor(jnp.asarray(out)),
+           Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+    if return_eids and ev is not None:
+        res = res + (Tensor(jnp.asarray(
+            np.concatenate(oeids) if oeids else np.zeros(0, np.int64))),)
+    return res
+
+
+sample_neighbors = graph_sample_neighbors  # python-api name
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              eids=None, sample_size=-1, return_eids=False,
+                              name=None):
+    """Weighted neighbour sampling (reference weighted_sample_neighbors):
+    neighbours drawn without replacement with probability proportional to
+    edge_weight (A-Res weighted reservoir, like the reference kernel)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    r, c = _csc(row, colptr)
+    _check_eids(eids, return_eids)
+    w = _np1d(edge_weight, np.float64)
+    xs = _np1d(input_nodes, np.int64)
+    ev = _np1d(eids, np.int64) if eids is not None else None
+    rng = np.random.default_rng()
+    outs, counts, oeids = [], [], []
+    for n in xs:
+        lo, hi = int(c[n]), int(c[n + 1])
+        deg = hi - lo
+        idx = np.arange(lo, hi)
+        if not (sample_size in (-1, None) or deg <= sample_size):
+            # A-Res: keys u^(1/w), take top sample_size
+            keys = rng.random(deg) ** (1.0 / np.maximum(w[idx], 1e-12))
+            idx = idx[np.argsort(-keys)[:sample_size]]
+        outs.append(r[idx])
+        counts.append(len(idx))
+        if return_eids and ev is not None:
+            oeids.append(ev[idx])
+    out = (np.concatenate(outs) if outs else np.zeros(0, np.int64))
+    res = (Tensor(jnp.asarray(out)),
+           Tensor(jnp.asarray(np.asarray(counts, np.int32))))
+    if return_eids and ev is not None:
+        res = res + (Tensor(jnp.asarray(
+            np.concatenate(oeids) if oeids else np.zeros(0, np.int64))),)
+    return res
+
+
+def graph_khop_sampler(row, colptr, x, eids=None, sample_sizes=(),
+                       return_eids=False, name=None):
+    """Multi-hop sampling + subgraph reindexing (reference
+    graph_khop_sampler / python `geometric.khop_sampler`): hop h samples
+    sample_sizes[h] neighbours of the frontier; the union of visited
+    nodes is renumbered [x first, then new nodes in discovery order].
+    Returns (out_src, out_dst, sample_index, reindex_x[, out_eids]) —
+    edges in LOCAL ids, the local->global map, and x's local ids."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+
+    r, c = _csc(row, colptr)
+    _check_eids(eids, return_eids)
+    xs = _np1d(x, np.int64)
+    ev = _np1d(eids, np.int64) if eids is not None else None
+    rng = np.random.default_rng()
+    local = {int(n): i for i, n in enumerate(xs)}
+    order = list(xs)
+    src, dst, es = [], [], []
+    frontier = list(xs)
+    for size in sample_sizes:
+        nxt = []
+        for n in frontier:
+            lo, hi = int(c[n]), int(c[n + 1])
+            deg = hi - lo
+            if size in (-1, None) or deg <= size:
+                sel = np.arange(lo, hi)
+            else:
+                sel = lo + rng.choice(deg, size=size, replace=False)
+            for j in sel:
+                nb = int(r[j])
+                if nb not in local:
+                    local[nb] = len(order)
+                    order.append(nb)
+                    nxt.append(nb)
+                src.append(local[nb])
+                dst.append(local[int(n)])
+                if ev is not None:
+                    es.append(ev[j])
+        frontier = nxt
+    res = (Tensor(jnp.asarray(np.asarray(src, np.int64))),
+           Tensor(jnp.asarray(np.asarray(dst, np.int64))),
+           Tensor(jnp.asarray(np.asarray(order, np.int64))),
+           Tensor(jnp.asarray(np.asarray([local[int(n)] for n in xs],
+                                         np.int64))))
+    if return_eids and ev is not None:
+        res = res + (Tensor(jnp.asarray(np.asarray(es, np.int64))),)
+    return res
+
+
+khop_sampler = graph_khop_sampler  # python-api name

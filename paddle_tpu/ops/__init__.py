@@ -1,1 +1,2 @@
-from paddle_tpu.ops import creation, linalg, logic, manipulation, math, search  # noqa: F401
+from paddle_tpu.ops import (creation, legacy_ps, linalg, logic,  # noqa: F401
+                            manipulation, math, search)

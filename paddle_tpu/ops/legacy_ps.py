@@ -1,0 +1,205 @@
+"""Legacy PS-recommendation / tree-retrieval / text-matching ops
+(r5 op-tail batch 2).
+
+Reference kernels: `paddle/phi/kernels/{impl,cpu,gpu}/batch_fc_*`,
+`rank_attention_*` (+ `funcs/rank_attention.cu.h` expansion kernels),
+`match_matrix_tensor_*`, `tdm_child_*`, `tdm_sampler_*`,
+`class_center_sample_*`, `merge_selected_rows_*` — the CTR/recommendation
+stack that fed the reference's parameter-server trainers.
+
+TPU-native notes: batch_fc / match_matrix_tensor / rank_attention are pure
+gather+einsum compositions (MXU-friendly, fully differentiable through
+jax AD); the tree ops (tdm_*) and sampling ops are host-side index
+manipulation like the reference's CPU-only kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = ["batch_fc", "rank_attention", "match_matrix_tensor",
+           "tdm_child", "tdm_sampler", "class_center_sample",
+           "merge_selected_rows", "SelectedRows"]
+
+
+def batch_fc(input, w, bias, name=None):
+    """Per-slot batched FC (reference batch_fc op, `impl/batch_fc_*`):
+    input [slot, B, in], w [slot, in, out], bias [slot, out] ->
+    [slot, B, out]. One bmm on the MXU."""
+    def fn(x, wv, b):
+        return jnp.einsum("sbi,sio->sbo", x, wv) + b[:, None, :]
+
+    return apply(fn, input, w, bias, _name="batch_fc")
+
+
+def rank_attention(x, rank_offset, rank_param, max_rank=3, max_size=0,
+                   name=None):
+    """Rank attention for CTR models (reference rank_attention op,
+    `funcs/rank_attention.cu.h` expand_input/expand_param + GEMM):
+    x [B, in]; rank_offset [B, 2*max_rank+1] int — col 0 is this
+    instance's rank (1-based, 0 = invalid), col 2k+1 the k-th
+    neighbour's rank, col 2k+2 the neighbour's row index into x;
+    rank_param [max_rank*max_rank*in, out] — block (lower*max_rank +
+    faster) is the [in, out] matrix for a (rank, neighbour-rank) pair.
+
+    out[b] = sum_k valid(b,k) * x[idx(b,k)] @ P[(rank_b-1)*max_rank +
+    (rank_k-1)] — exactly the expanded GEMM the reference runs, as one
+    gather + einsum."""
+    def fn(xv, ro, pv):
+        B, in_col = xv.shape
+        out_col = pv.shape[-1]
+        P = pv.reshape(max_rank * max_rank, in_col, out_col)
+        cur = ro[:, 0].astype(jnp.int32) - 1              # [B]
+        others = ro[:, 1::2].astype(jnp.int32) - 1        # [B, max_rank]
+        idxs = ro[:, 2::2].astype(jnp.int32)              # [B, max_rank]
+        valid = (cur[:, None] >= 0) & (others >= 0)
+        xg = xv[jnp.clip(idxs, 0, B - 1)]                 # [B, K, in]
+        block = (jnp.clip(cur[:, None], 0) * max_rank
+                 + jnp.clip(others, 0))
+        Pb = P[jnp.clip(block, 0, max_rank * max_rank - 1)]
+        xg = jnp.where(valid[..., None], xg, 0.0)
+        return jnp.einsum("bki,bkio->bo", xg, Pb)
+
+    return apply(fn, x, rank_offset, rank_param, _name="rank_attention")
+
+
+def match_matrix_tensor(x, y, w, dim_t=1, name=None):
+    """Bilinear text-matching tensor (reference match_matrix_tensor op):
+    x [B, Lx, D], y [B, Ly, D], w [D, dim_t, D] ->
+    out [B, dim_t, Lx, Ly] with out[b,t,i,j] = x[b,i] @ w[:,t,:] @ y[b,j]
+    (the reference packs LoD sequences; padded batch here). Returns
+    (out, tmp) where tmp = x @ w ([B, Lx, dim_t, D]), matching the
+    kernel's two outputs."""
+    def fn(xv, yv, wv):
+        tmp = jnp.einsum("bid,dte->bite", xv, wv)
+        out = jnp.einsum("bite,bje->btij", tmp, yv)
+        return out, tmp
+
+    return apply(fn, x, y, w, _name="match_matrix_tensor")
+
+
+def tdm_child(x, tree_info, child_nums, dtype="int32", name=None):
+    """Children lookup in a TDM tree (reference tdm_child op,
+    `cpu/tdm_child_kernel`): tree_info rows are
+    [item_id, layer_id, parent_id, child_0 ... child_{n-1}] (0 = none).
+    Returns (child [N..., child_nums], leaf_mask) where leaf_mask is 1
+    for children that are LEAVES (their item_id != 0)."""
+    xi = np.asarray(x._data if isinstance(x, Tensor) else x).astype(np.int64)
+    ti = np.asarray(tree_info._data if isinstance(tree_info, Tensor)
+                    else tree_info).astype(np.int64)
+    flat = xi.reshape(-1)
+    child = ti[flat][:, 3:3 + child_nums]
+    item_of_child = ti[np.clip(child, 0, ti.shape[0] - 1), 0]
+    leaf = ((child != 0) & (item_of_child != 0)).astype(np.int64)
+    shape = xi.shape + (child_nums,)
+    dt = jnp.int32 if str(dtype) in ("int32", "2") else jnp.int64
+    return (Tensor(jnp.asarray(child.reshape(shape)).astype(dt)),
+            Tensor(jnp.asarray(leaf.reshape(shape)).astype(dt)))
+
+
+def tdm_sampler(x, travel, layer, output_positive=True,
+                neg_samples_num_list=(), layer_offset=(), seed=0,
+                dtype=2, name=None):
+    """Per-layer positive + negative sampling along a TDM tree path
+    (reference tdm_sampler op, `cpu/tdm_sampler_kernel`): travel [N, L]
+    holds sample n's path node per layer; `layer` is the flat node list
+    with layer l spanning layer_offset[l]:layer_offset[l+1]. For each
+    sample and layer: emit the positive path node (label 1) and
+    neg_samples_num_list[l] uniform negatives != positive (label 0).
+    Returns (out [N, total], label, mask) — mask 0 marks padded slots of
+    samples whose path ended early (travel node 0)."""
+    rng = np.random.RandomState(seed or None)
+    xv = np.asarray(x._data if isinstance(x, Tensor) else x)
+    tr = np.asarray(travel._data if isinstance(travel, Tensor)
+                    else travel).astype(np.int64)
+    ly = np.asarray(layer._data if isinstance(layer, Tensor)
+                    else layer).astype(np.int64).reshape(-1)
+    N, L = tr.shape
+    offs = list(layer_offset) or list(
+        np.linspace(0, len(ly), L + 1).astype(int))
+    negs = list(neg_samples_num_list) or [1] * L
+    per_layer = [(1 if output_positive else 0) + negs[l] for l in range(L)]
+    total = sum(per_layer)
+    out = np.zeros((N, total), np.int64)
+    lab = np.zeros((N, total), np.int64)
+    mask = np.zeros((N, total), np.int64)
+    for n in range(N):
+        col = 0
+        for l in range(L):
+            pos = tr[n, l]
+            nodes = ly[offs[l]:offs[l + 1]]
+            alive = pos != 0
+            if output_positive:
+                out[n, col] = pos
+                lab[n, col] = 1 if alive else 0
+                mask[n, col] = 1 if alive else 0
+                col += 1
+            for _ in range(negs[l]):
+                if alive and len(nodes) > 1:
+                    while True:
+                        cand = nodes[rng.randint(len(nodes))]
+                        if cand != pos:
+                            break
+                    out[n, col] = cand
+                    mask[n, col] = 1
+                col += 1
+    dt = jnp.int64 if int(dtype) == 3 else jnp.int32
+    return (Tensor(jnp.asarray(out).astype(dt)),
+            Tensor(jnp.asarray(lab).astype(dt)),
+            Tensor(jnp.asarray(mask).astype(dt)))
+
+
+def class_center_sample(label, num_classes, num_samples, ring_id=0, rank=0,
+                        nranks=1, fix_seed=False, seed=0, name=None):
+    """Sample class centers for partial-FC face recognition (reference
+    class_center_sample op): keep every class present in `label`, fill up
+    to num_samples with uniform negatives, return (remapped_label,
+    sampled_class_index). Host-side sampling like the reference CPU
+    kernel."""
+    lv = np.asarray(label._data if isinstance(label, Tensor)
+                    else label).astype(np.int64).reshape(-1)
+    rng = np.random.RandomState(seed if fix_seed else None)
+    pos = np.unique(lv)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos,
+                            assume_unique=False)
+        extra = rng.choice(rest, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.concatenate([pos, np.sort(extra)])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return (Tensor(jnp.asarray(remap[lv])),
+            Tensor(jnp.asarray(sampled)))
+
+
+class SelectedRows:
+    """Minimal SelectedRows container (reference
+    `paddle/phi/core/selected_rows.h`): a sparse set of rows of a
+    [height, ...] tensor — `rows` may repeat; `merge_selected_rows` sums
+    duplicates."""
+
+    def __init__(self, rows, value, height=None):
+        self.rows = np.asarray(rows, np.int64).reshape(-1)
+        self.value = value if isinstance(value, Tensor) else Tensor(
+            jnp.asarray(value))
+        self.height = height if height is not None else (
+            int(self.rows.max()) + 1 if self.rows.size else 0)
+
+
+def merge_selected_rows(x, name=None):
+    """Sum duplicate rows of a SelectedRows (reference merge_selected_rows
+    op, `phi/kernels/selected_rows/merge_selected_rows_kernel` — the
+    gradient-merge step for sparse embedding grads): one
+    segment-sum on device."""
+    if not isinstance(x, SelectedRows):
+        raise TypeError("merge_selected_rows takes a SelectedRows")
+    uniq, inv = np.unique(x.rows, return_inverse=True)
+    merged = jax.ops.segment_sum(x.value._data, jnp.asarray(inv),
+                                 num_segments=len(uniq))
+    return SelectedRows(uniq, Tensor(merged), x.height)

@@ -738,3 +738,295 @@ def psroi_pool(x, boxes, boxes_num, output_size=None, spatial_scale=1.0,
     out = (jnp.stack(outs) if outs
            else jnp.zeros((0, out_c, ph, pw), jnp.float32))
     return Tensor(out)
+
+
+def correlation(input1, input2, pad_size=4, kernel_size=1,
+                max_displacement=4, stride1=1, stride2=1,
+                corr_type_multiply=1, name=None):
+    """Correlation cost volume (reference correlation op,
+    `phi/kernels/gpu/correlation_kernel` — FlowNet's matching layer):
+    corr[b, d, y, x] = mean_c x1[b, c, y, x] * x2[b, c, y+dy, x+dx] over
+    the (2*max_displacement/stride2 + 1)^2 displacement grid. Implemented
+    as shifted elementwise products — D^2 fused multiplies, no gather."""
+    if corr_type_multiply != 1:
+        raise NotImplementedError(
+            "correlation: only corr_type_multiply=1 (multiplicative) is "
+            "implemented — the same restriction as the reference kernel")
+
+    def fn(a, b):
+        B, C, H, W = a.shape
+        pad = [(0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size)]
+        ap = jnp.pad(a, pad)
+        bp = jnp.pad(b, pad)
+        r = max_displacement // stride2
+        disps = [(dy * stride2, dx * stride2)
+                 for dy in range(-r, r + 1) for dx in range(-r, r + 1)]
+        k = int(kernel_size)
+        outs = []
+        for dy, dx in disps:
+            shifted = jnp.roll(bp, shift=(-dy, -dx), axis=(2, 3))
+            prod = (ap * shifted).mean(axis=1)  # [B, H+2p, W+2p]
+            if k > 1:
+                # patch correlation: mean over the kernel_size^2 window
+                # centered on each pixel (reference correlation_funcs
+                # nelems = K*K*C)
+                prod = jax.lax.reduce_window(
+                    prod, 0.0, jax.lax.add, (1, k, k), (1, 1, 1),
+                    "SAME") / (k * k)
+            outs.append(prod[:, pad_size:pad_size + H,
+                             pad_size:pad_size + W])
+        out = jnp.stack(outs, axis=1)  # [B, D^2, H, W]
+        if stride1 > 1:
+            out = out[:, :, ::stride1, ::stride1]
+        return out
+
+    return apply(fn, input1, input2, _name="correlation")
+
+
+def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(),
+              anchor_mask=(), class_num=1, ignore_thresh=0.7,
+              downsample_ratio=32, use_label_smooth=True, scale_x_y=1.0,
+              name=None):
+    """YOLOv3 training loss (reference yolo_loss / yolov3_loss op,
+    `phi/kernels/cpu/yolo_loss_kernel.cc`): per ground-truth box, the
+    best-wh-IoU anchor (over ALL anchors) owns it; if that anchor is in
+    this head's anchor_mask the owning grid cell gets coordinate (BCE xy
+    + L2 wh, weighted 2 - w*h), objectness = gt_score (the mixup
+    confidence, :342) and label-smoothed class BCE targets
+    (smooth_weight = min(1/C, 1/40), :212-217); predictions overlapping
+    any gt beyond ignore_thresh are excluded from the noobj objectness
+    term. Fully differentiable and VECTORIZED over the gt axis — one
+    broadcasted IoU + gather/scatter, graph size independent of G.
+
+    x [B, A*(5+C), H, W]; gt_box [B, G, 4] (cx, cy, w, h normalized);
+    gt_label [B, G] int; gt_score [B, G] (None = 1s). Returns loss [B].
+    """
+    def _bce(p, t):
+        p = jnp.clip(jax.nn.sigmoid(p), 1e-7, 1 - 1e-7)
+        return -(t * jnp.log(p) + (1 - t) * jnp.log(1 - p))
+
+    if gt_score is None:
+        gt_score = Tensor(jnp.ones(_data(gt_label).shape, jnp.float32))
+
+    def _loss(xd, gb, gl, gs):
+        xd = xd.astype(jnp.float32)
+        gb = gb.astype(jnp.float32)
+        gl = gl.astype(jnp.int32)
+        gs = gs.astype(jnp.float32)
+        B, _, H, W = xd.shape
+        A = len(anchor_mask)
+        C = int(class_num)
+        G = gb.shape[1]
+        an_all = jnp.asarray(np.asarray(anchors, np.float32).reshape(-1, 2))
+        mask_arr = np.asarray(anchor_mask, np.int64)
+        an = an_all[jnp.asarray(mask_arr)]
+        input_w = W * downsample_ratio
+        input_h = H * downsample_ratio
+        feat = xd.reshape(B, A, 5 + C, H, W)
+        tx, ty, tw, th, tobj = (feat[:, :, 0], feat[:, :, 1],
+                                feat[:, :, 2], feat[:, :, 3], feat[:, :, 4])
+        tcls = feat[:, :, 5:]
+
+        # decoded pred boxes (normalized) for the ignore mask
+        cgx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        cgy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        px = (jax.nn.sigmoid(tx) + cgx) / W
+        py = (jax.nn.sigmoid(ty) + cgy) / H
+        pw = jnp.exp(tw) * an[None, :, 0, None, None] / input_w
+        ph = jnp.exp(th) * an[None, :, 1, None, None] / input_h
+
+        def iou_xywh(x1, y1, w1, h1, x2, y2, w2, h2):
+            l = jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)
+            r = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+            t = jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+            bt = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+            inter = jnp.clip(r - l, 0) * jnp.clip(bt - t, 0)
+            return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+        # ignore mask: best IoU of each prediction vs ALL gts, one
+        # broadcast over the G axis ([B, G, A, H, W] transient)
+        iou_all = iou_xywh(
+            px[:, None], py[:, None], pw[:, None], ph[:, None],
+            gb[:, :, 0, None, None, None], gb[:, :, 1, None, None, None],
+            gb[:, :, 2, None, None, None], gb[:, :, 3, None, None, None])
+        noobj_mask = (iou_all.max(axis=1) < ignore_thresh).astype(
+            jnp.float32)
+
+        # per-gt anchor assignment, vectorized over [B, G]
+        cx, cy, w, h = gb[..., 0], gb[..., 1], gb[..., 2], gb[..., 3]
+        has = (w > 0) & (h > 0)
+        gw = w[..., None] * input_w
+        gh = h[..., None] * input_h
+        iw = jnp.minimum(gw, an_all[None, None, :, 0])
+        ih = jnp.minimum(gh, an_all[None, None, :, 1])
+        inter = iw * ih
+        wh_iou = inter / jnp.maximum(
+            gw * gh + an_all[None, None, :, 0] * an_all[None, None, :, 1]
+            - inter, 1e-10)
+        best_a = jnp.argmax(wh_iou, axis=-1)           # [B, G] global idx
+        lut_local = np.full(len(an_all), 0, np.int64)
+        lut_in = np.zeros(len(an_all), bool)
+        for k, m in enumerate(mask_arr):
+            lut_local[m] = k
+            lut_in[m] = True
+        local_a = jnp.asarray(lut_local)[best_a]       # [B, G]
+        own = has & jnp.asarray(lut_in)[best_a]
+        m = own.astype(jnp.float32)
+
+        gi = jnp.clip((cx * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((cy * H).astype(jnp.int32), 0, H - 1)
+        bidx = jnp.arange(B)[:, None].repeat(G, 1)
+        sel = (bidx, local_a, gj, gi)
+
+        t_x = cx * W - gi
+        t_y = cy * H - gj
+        aw = an[jnp.clip(local_a, 0, A - 1)]           # [B, G, 2]
+        t_w = jnp.log(jnp.maximum(w * input_w / aw[..., 0], 1e-9))
+        t_h = jnp.log(jnp.maximum(h * input_h / aw[..., 1], 1e-9))
+        scale = (2.0 - w * h) * gs
+
+        loss = (m * scale * (_bce(tx[sel], t_x) + _bce(ty[sel], t_y))
+                ).sum(-1)
+        loss = loss + (m * scale * 0.5 * ((tw[sel] - t_w) ** 2
+                                          + (th[sel] - t_h) ** 2)).sum(-1)
+
+        # class targets: smooth_weight = min(1/C, 1/40) (reference
+        # :212-217); label_pos = 1 - sw, label_neg = sw
+        sw = min(1.0 / C, 1.0 / 40.0) if (use_label_smooth and C > 1)             else 0.0
+        cls_t = jax.nn.one_hot(gl, C) * (1.0 - 2.0 * sw) + sw
+        cls_pred = tcls[bidx, local_a, :, gj, gi]      # [B, G, C]
+        loss = loss + (m * gs * _bce(cls_pred, cls_t).sum(-1)).sum(-1)
+
+        # objectness: the positive target is the MIXUP SCORE (reference
+        # :342 obj_mask_data[obj_idx] = score), not 1.0
+        obj_target = jnp.zeros((B, A, H, W), jnp.float32)
+        obj_target = obj_target.at[sel].max(m * gs)
+        pos = (obj_target > 0).astype(jnp.float32)
+        loss = loss + (pos * _bce(tobj, obj_target)).sum((1, 2, 3))
+        loss = loss + ((1 - pos) * noobj_mask
+                       * _bce(tobj, 0.0)).sum((1, 2, 3))
+        return loss
+
+    return apply(_loss, x, gt_box, gt_label, gt_score, _name="yolo_loss")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference deformable_conv op,
+    `phi/kernels/impl/deformable_conv_kernel_impl.h`; python api
+    `vision/ops.py deform_conv2d`): each kernel tap samples the input at
+    a LEARNED offset from its integer position (bilinear), optionally
+    modulated by `mask` (v2). TPU-native: the deformable im2col is a
+    batched bilinear gather per tap (K taps, static loop) followed by one
+    einsum — the same gather+MXU pattern as roi_align."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    sh, sw = pair(stride)
+    ph, pw = pair(padding)
+    dh, dw = pair(dilation)
+
+    def fn(xd, off, wd, *rest):
+        i = 0
+        bd = None
+        md = None
+        if bias is not None:
+            bd = rest[i]
+            i += 1
+        if mask is not None:
+            md = rest[i]
+        B, Cin, H, W = xd.shape
+        Cout, Cin_g, KH, KW = wd.shape
+        K = KH * KW
+        Ho = (H + 2 * ph - (dh * (KH - 1) + 1)) // sh + 1
+        Wo = (W + 2 * pw - (dw * (KW - 1) + 1)) // sw + 1
+        dg = deformable_groups
+        off = off.reshape(B, dg, K, 2, Ho, Wo)
+        base_y = (jnp.arange(Ho) * sh - ph)[None, :, None]
+        base_x = (jnp.arange(Wo) * sw - pw)[None, None, :]
+
+        cols = []
+        for k in range(K):
+            kh, kw = k // KW, k % KW
+            # offset layout (reference deformable_conv_functor): (dy, dx)
+            py = (base_y + kh * dh) + off[:, :, k, 0]  # [B, dg, Ho, Wo]
+            px = (base_x + kw * dw) + off[:, :, k, 1]
+            valid = ((py > -1) & (py < H) & (px > -1)
+                     & (px < W)).astype(jnp.float32)
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy = py - y0
+            wx = px - x0
+            y0i = jnp.clip(y0, 0, H - 1).astype(jnp.int32)
+            x0i = jnp.clip(x0, 0, W - 1).astype(jnp.int32)
+            y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            xg = xd.reshape(B, dg, Cin // dg, H, W)
+
+            def gat(yy, xx):
+                # flat gather over H*W per (b, dg) -> [B, dg, C/dg, Ho, Wo]
+                return jnp.take_along_axis(
+                    xg.reshape(B, dg, Cin // dg, H * W),
+                    (yy * W + xx)[:, :, None, :, :].reshape(
+                        B, dg, 1, Ho * Wo),
+                    axis=3).reshape(B, dg, Cin // dg, Ho, Wo)
+
+            v = (gat(y0i, x0i) * ((1 - wy) * (1 - wx))[:, :, None]
+                 + gat(y0i, x1i) * ((1 - wy) * wx)[:, :, None]
+                 + gat(y1i, x0i) * (wy * (1 - wx))[:, :, None]
+                 + gat(y1i, x1i) * (wy * wx)[:, :, None])
+            v = v * valid[:, :, None]
+            if md is not None:
+                mk = md.reshape(B, dg, K, Ho, Wo)[:, :, k]
+                v = v * mk[:, :, None]
+            cols.append(v.reshape(B, Cin, Ho, Wo))
+        col = jnp.stack(cols, axis=2)  # [B, Cin, K, Ho, Wo]
+        wg = wd.reshape(groups, Cout // groups, Cin_g, KH * KW)
+        cg = col.reshape(B, groups, Cin // groups, K, Ho, Wo)
+        out = jnp.einsum("goik,bgikhw->bgohw", wg, cg)
+        out = out.reshape(B, Cout, Ho, Wo)
+        if bd is not None:
+            out = out + bd.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if bias is not None:
+        args.append(bias)
+    if mask is not None:
+        args.append(mask)
+    return apply(fn, *args, _name="deform_conv2d")
+
+
+# yaml op name (ops.yaml deformable_conv); deform_conv2d is the python api
+deformable_conv = deform_conv2d
+
+
+def read_file(filename, dtype="uint8", place=None, name=None):
+    """Read raw bytes into a uint8 tensor (reference read_file op,
+    `vision/ops.py read_file` — the file half of the decode pipeline)."""
+    data = np.fromfile(filename, dtype=np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (reference decode_jpeg
+    op, `phi/kernels/gpu/decode_jpeg_kernel` over nvjpeg): host-side via
+    PIL here — image decode feeds the input pipeline, not the compiled
+    graph."""
+    import io
+
+    from PIL import Image
+
+    buf = bytes(np.asarray(_data(x), np.uint8).tobytes())
+    img = Image.open(io.BytesIO(buf))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

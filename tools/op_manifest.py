@@ -233,32 +233,16 @@ SUBSUMED = {
 SKIPS = {
     # legacy parameter-server / recommendation stack (SURVEY: defensible skip)
     "pyramid_hash": "legacy PS sparse-recommendation op",
-    "tdm_child": "legacy PS tree-based recommendation",
-    "tdm_sampler": "legacy PS tree-based recommendation",
-    "rank_attention": "legacy PS recommendation",
-    "batch_fc": "legacy PS recommendation",
-    "match_matrix_tensor": "legacy text-matching op",
     # mobile/detection zoo: out of scope for the north-star configs
     "yolo_box_head": "detection zoo",
     "yolo_box_post": "detection zoo",
-    "yolo_loss": "detection zoo",
-    "deformable_conv": "detection zoo kernel",
-    "correlation": "optical-flow kernel",
     "collect_fpn_proposals ": "detection zoo",
     "anchor_generator": "detection zoo",
     # host-side / data-dependent-shape graph sampling
-    "graph_khop_sampler": "host-side graph sampling (dynamic shapes)",
-    "graph_sample_neighbors": "host-side graph sampling",
-    "weighted_sample_neighbors": "host-side graph sampling",
     "reindex_graph": "host-side graph reindexing",
     # io codecs
-    "decode_jpeg": "host-side image decode (use PIL/np in Dataset)",
-    "read_file": "host-side file read",
     # niche sequence decoders
-    "warprnnt": "RNN-T loss (niche; CTC covered)",
-    "class_center_sample": "face-recognition sampling (niche)",
     "get_tensor_from_selected_rows": "SelectedRows legacy container",
-    "merge_selected_rows": "SelectedRows legacy container",
 }
 
 
