@@ -23,7 +23,7 @@ from paddle_tpu.core.tensor import Tensor, apply
 
 __all__ = ["batch_fc", "rank_attention", "match_matrix_tensor",
            "tdm_child", "tdm_sampler", "class_center_sample",
-           "merge_selected_rows", "SelectedRows"]
+           "merge_selected_rows", "SelectedRows", "pyramid_hash"]
 
 
 def batch_fc(input, w, bias, name=None):
@@ -203,3 +203,87 @@ def merge_selected_rows(x, name=None):
     merged = jax.ops.segment_sum(x.value._data, jnp.asarray(inv),
                                  num_segments=len(uniq))
     return SelectedRows(uniq, Tensor(merged), x.height)
+
+
+def pyramid_hash(x, w, white_list=None, black_list=None, num_emb=0,
+                 space_len=0, pyramid_layer=2, rand_len=0,
+                 drop_out_percent=0.0, is_training=False, use_filter=True,
+                 white_list_len=0, black_list_len=0, seed=0,
+                 lr=0.0, distribute_update_vars="", name=None):
+    """Pyramid hash embedding (reference pyramid_hash op,
+    `phi/kernels/cpu/pyramid_hash_kernel.cc` — hashed n-gram embeddings
+    for PS text matching): every n-gram of length 2..pyramid_layer+1 in
+    the id sequence is hashed into `w` [space_len + rand_len, 1]; its
+    embedding is num_emb/rand_len chunks of rand_len weights, chunk j
+    starting at hash(ngram, seed=j*rand_len... ) % space_len (the
+    kernel's rolling XXH32 scheme). x [L] or [B, L] int ids -> per-term
+    rows [n_terms, num_emb] (batch: [B, n_terms, num_emb]).
+
+    Divergence: the hash is zlib.crc32(bytes, seed) instead of XXH32
+    (not available without the xxhash dep) — same structure,
+    checkpoint-incompatible hash positions; white/black bloom filters
+    accept explicit id-list arrays instead of serialized bloomfilters."""
+    import zlib
+
+    xv = np.asarray(x._data if isinstance(x, Tensor) else x, np.int64)
+    wv = np.asarray(w._data if isinstance(w, Tensor) else w,
+                    np.float32).reshape(-1)
+    wl = (set(np.asarray(white_list._data if isinstance(white_list, Tensor)
+                         else white_list, np.int64).ravel().tolist())
+          if white_list is not None and use_filter else None)
+    bl = (set(np.asarray(black_list._data if isinstance(black_list, Tensor)
+                         else black_list, np.int64).ravel().tolist())
+          if black_list is not None and use_filter else None)
+    if rand_len <= 0 or num_emb <= 0 or num_emb % rand_len:
+        raise ValueError("pyramid_hash needs num_emb > 0 divisible by "
+                         "rand_len > 0")
+    if space_len <= 0:
+        raise ValueError("pyramid_hash needs space_len > 0 (the hash "
+                         "bucket count; w holds space_len + rand_len "
+                         "rows)")
+    if len(wv) < space_len + rand_len:
+        raise ValueError(f"w has {len(wv)} weights; needs >= space_len + "
+                         f"rand_len = {space_len + rand_len}")
+    batched = xv.ndim == 2
+    seqs = xv if batched else xv[None]
+    rng = np.random.RandomState(seed or None)
+
+    def h(ngram, s):
+        # hash the int64 id bytes directly: a float32 round-trip would
+        # collide all ids above 2^24
+        return zlib.crc32(ngram.tobytes() + np.int32(s).tobytes()) \
+            % space_len
+
+    outs = []
+    for seq in seqs:
+        rows = []
+        L = len(seq)
+        for d in range(2, pyramid_layer + 2):       # n-gram lengths
+            for i in range(L - d + 1):
+                ng = seq[i:i + d].astype(np.int64)
+                # token-level filters: a term passes the whitelist iff
+                # ALL its tokens are listed, and is dropped if ANY token
+                # is blacklisted (the reference filters with bloomfilters
+                # over term bytes; id lists filter per token here)
+                if wl is not None and not all(int(t) in wl for t in ng):
+                    continue
+                if bl is not None and any(int(t) in bl for t in ng):
+                    continue
+                emb = np.zeros(num_emb, np.float32)
+                pos = h(ng, 0)
+                for j in range(0, num_emb, rand_len):
+                    emb[j:j + rand_len] = wv[pos:pos + rand_len]
+                    pos = h(ng, j + rand_len)
+                if is_training and drop_out_percent > 0 and \
+                        rng.rand() < drop_out_percent:
+                    emb[:] = 0.0
+                rows.append(emb)
+        outs.append(np.stack(rows) if rows
+                    else np.zeros((0, num_emb), np.float32))
+    if batched:
+        n = max(o.shape[0] for o in outs)
+        padded = np.zeros((len(outs), n, num_emb), np.float32)
+        for i, o in enumerate(outs):
+            padded[i, :o.shape[0]] = o
+        return Tensor(jnp.asarray(padded))
+    return Tensor(jnp.asarray(outs[0]))

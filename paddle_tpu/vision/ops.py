@@ -1030,3 +1030,71 @@ def decode_jpeg(x, mode="unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return Tensor(jnp.asarray(arr))
+
+
+def yolo_box_head(x, anchors=(), class_num=1, name=None):
+    """The PP-YOLO inference head transform (reference yolo_box_head op /
+    TensorRT plugin `yolo_box_head_op_plugin.cu`): per anchor slot,
+    sigmoid on x, y, objectness, and class channels; w/h raw (the decode
+    to boxes happens in yolo_box_post). Pure elementwise."""
+    def fn(xd):
+        B, _, H, W = xd.shape
+        A = len(anchors) // 2
+        f = xd.reshape(B, A, 5 + class_num, H, W)
+        sig = jax.nn.sigmoid(f)
+        out = f.at[:, :, 0:2].set(sig[:, :, 0:2])
+        out = out.at[:, :, 4:].set(sig[:, :, 4:])
+        return out.reshape(B, A * (5 + class_num), H, W)
+
+    return apply(fn, x, _name="yolo_box_head")
+
+
+def yolo_box_post(boxes0, boxes1, boxes2, image_shape, image_scale,
+                  anchors0=(), anchors1=(), anchors2=(), class_num=1,
+                  conf_thresh=0.01, downsample_ratio0=32,
+                  downsample_ratio1=16, downsample_ratio2=8,
+                  clip_bbox=True, scale_x_y=1.0, nms_threshold=0.45,
+                  name=None):
+    """Multi-level YOLO postprocess (reference yolo_box_post op): decode
+    the three heads with yolo_box, concat, threshold, class-aware greedy
+    NMS, emit (label, score, x1, y1, x2, y2) rows + per-image counts.
+    Device decode + host packing (the output count is data-dependent,
+    like the reference kernel)."""
+    ims = _data(image_shape).astype(jnp.float32).reshape(-1, 2)
+    scale = np.asarray(_data(image_scale), np.float32).reshape(-1)
+    levels = ((boxes0, anchors0, downsample_ratio0),
+              (boxes1, anchors1, downsample_ratio1),
+              (boxes2, anchors2, downsample_ratio2))
+    all_boxes, all_scores = [], []
+    for feat, an, ds in levels:
+        b, s = yolo_box(feat, Tensor(ims), anchors=list(an),
+                        class_num=class_num, conf_thresh=conf_thresh,
+                        downsample_ratio=ds, clip_bbox=clip_bbox,
+                        scale_x_y=scale_x_y)
+        all_boxes.append(np.asarray(b.numpy()))
+        all_scores.append(np.asarray(s.numpy()))
+    bx = np.concatenate(all_boxes, axis=1)    # [B, N, 4]
+    sc = np.concatenate(all_scores, axis=1)   # [B, N, C]
+    B = bx.shape[0]
+    outs, counts = [], []
+    for bi in range(B):
+        dets = []
+        for c in range(class_num):
+            s = sc[bi, :, c]
+            cand = np.where(s > conf_thresh)[0]
+            if cand.size == 0:
+                continue
+            cand = cand[np.argsort(-s[cand])]
+            kept = np.asarray(nms(Tensor(jnp.asarray(bx[bi, cand])),
+                                  iou_threshold=nms_threshold).numpy())
+            for j in kept:
+                gi = cand[int(j)]
+                box = bx[bi, gi] / max(scale[bi % len(scale)], 1e-9)
+                dets.append((c, s[gi], *box))
+        dets.sort(key=lambda d: -d[1])
+        counts.append(len(dets))
+        outs.extend(d for d in dets)
+    out = (np.asarray(outs, np.float32).reshape(-1, 6) if outs
+           else np.zeros((0, 6), np.float32))
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(np.asarray(counts, np.int32))))

@@ -230,20 +230,8 @@ SUBSUMED = {
     "repeat_interleave_with_tensor_index": "paddle.repeat_interleave",
 }
 
-SKIPS = {
-    # legacy parameter-server / recommendation stack (SURVEY: defensible skip)
-    "pyramid_hash": "legacy PS sparse-recommendation op",
-    # mobile/detection zoo: out of scope for the north-star configs
-    "yolo_box_head": "detection zoo",
-    "yolo_box_post": "detection zoo",
-    "collect_fpn_proposals ": "detection zoo",
-    "anchor_generator": "detection zoo",
-    # host-side / data-dependent-shape graph sampling
-    "reindex_graph": "host-side graph reindexing",
-    # io codecs
-    # niche sequence decoders
-    "get_tensor_from_selected_rows": "SelectedRows legacy container",
-}
+SKIPS = {}  # r5: every ops.yaml op is implemented, aliased, or subsumed —
+# the coverage test pins skipped == 0, so this dict stays empty by design
 
 
 def ref_ops():
