@@ -1,5 +1,6 @@
 """paddle.autograd (reference: `python/paddle/autograd/`): backward, PyLayer, hooks."""
 
+import numpy as np
 from paddle_tpu.core.backward import run_backward, grad  # noqa: F401
 from paddle_tpu.core.tensor import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
 from paddle_tpu.core.tensor import Tensor, GradNode
@@ -121,3 +122,79 @@ class saved_tensors_hooks:
 
     def __exit__(self, *args):
         return False
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Dense Jacobian d(ys)/d(xs) (reference `paddle.autograd.jacobian`,
+    `autograd/autograd.py` Jacobian): computed row-by-row with vjps over
+    the recorded tape (retain_graph), create_graph so the result itself
+    is differentiable. ys, xs: Tensors (or lists). Returns [ys.size,
+    xs.size]-shaped Tensor (lists -> nested lists), or with
+    batch_axis=0 a [B, ys_row, xs_row] batched Jacobian."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.backward import grad as _grad
+    from paddle_tpu.core.tensor import Tensor
+
+    ys_list = ys if isinstance(ys, (list, tuple)) else [ys]
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+
+    def one(y, x):
+        rows = []
+        ysz = int(np.prod(y.shape)) if y.ndim else 1
+        for i in range(ysz):
+            seed = jnp.zeros((ysz,), y.dtype).at[i].set(1.0)
+            seed = seed.reshape(y.shape)
+            gi = _grad([y], [x], grad_outputs=[Tensor(seed)],
+                       retain_graph=True, create_graph=True,
+                       allow_unused=True)[0]
+            if gi is None:
+                gi = Tensor(jnp.zeros(x.shape, x.dtype))
+            rows.append(gi.reshape([-1]))
+        from paddle_tpu.ops.manipulation import stack
+
+        out = stack(rows, axis=0)  # [ys.size, xs.size]
+        if batch_axis == 0:
+            # per-sample Jacobian: the b-th block of the block-diagonal
+            # [B, M, B, N] structure — NOT a reshape of the dense matrix
+            # (which would span all batches' xs on the last axis)
+            B = y.shape[0]
+            M = ysz // B if B else 0
+            N = (int(np.prod(x.shape)) // x.shape[0]) if x.ndim else 1
+            blocks = out._data.reshape(B, M, x.shape[0], N)
+            diag = jnp.diagonal(blocks, axis1=0, axis2=2)  # [M, N, B]
+            return Tensor(jnp.moveaxis(diag, -1, 0))       # [B, M, N]
+        return out
+
+    if isinstance(ys, (list, tuple)) or isinstance(xs, (list, tuple)):
+        return [[one(y, x) for x in xs_list] for y in ys_list]
+    return one(ys, xs)
+
+
+def hessian(ys, xs, batch_axis=None):
+    """Dense Hessian of a scalar ys w.r.t. xs (reference
+    `paddle.autograd.hessian`): jacobian of the create_graph gradient —
+    exact double backward over the re-taped vjps."""
+    from paddle_tpu.core.backward import grad as _grad
+
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    if sum(int(np.prod(y.shape)) if hasattr(y, "shape") else 1
+           for y in (ys if isinstance(ys, (list, tuple)) else [ys])) != 1:
+        raise ValueError("hessian needs a scalar ys")
+    y = ys[0] if isinstance(ys, (list, tuple)) else ys
+    gs = _grad([y], list(xs_list), retain_graph=True, create_graph=True,
+               allow_unused=True)
+
+    def jac_or_zero(g, x):
+        if g is None:  # y independent of this x: a zero block
+            from paddle_tpu.core.tensor import Tensor
+            import jax.numpy as jnp
+
+            n = int(np.prod(x.shape)) if x.ndim else 1
+            return Tensor(jnp.zeros((n, n), x.dtype))
+        return jacobian(g, x)
+
+    outs = [[jac_or_zero(g, x) for x in xs_list] for g in gs]
+    if isinstance(xs, (list, tuple)):
+        return outs
+    return outs[0][0]

@@ -50,7 +50,8 @@ def _is_float0(arr):
     return hasattr(arr, "dtype") and arr.dtype == jax.dtypes.float0
 
 
-def run_backward(tensors, grad_tensors=None, retain_graph=False, leaf_filter=None):
+def run_backward(tensors, grad_tensors=None, retain_graph=False,
+                 leaf_filter=None, create_graph=False):
     """Seed cotangents on `tensors` and propagate to all reachable leaves.
 
     leaf_filter: optional set of tensor ids; when given, gradients land only
@@ -58,6 +59,16 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, leaf_filter=Non
     unrelated parameters)."""
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
+    if create_graph:
+        retain_graph = True  # the re-taped grads reference the nodes
+
+    def wrap(a):
+        # create_graph: cotangents travel as TAPED Tensors so the computed
+        # grads carry their own graph (reference double-grad,
+        # eager/general_grad.h); otherwise raw arrays
+        if not create_graph:
+            return a._data if isinstance(a, Tensor) else a
+        return a if isinstance(a, Tensor) else Tensor(a)
 
     # seed
     roots = []
@@ -71,9 +82,9 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, leaf_filter=Non
                     "got shape {}".format(t.shape)
                 )
             g = jnp.ones_like(t._data)
-        else:
-            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
-        roots.append((t, g))
+        elif not isinstance(g, Tensor):
+            g = jnp.asarray(g)
+        roots.append((t, wrap(g)))
 
     # collect reachable node graph + consumer counts (in-degree for Kahn)
     indegree = {}
@@ -118,16 +129,20 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, leaf_filter=Non
 
         node.ensure_pending()
         cotangents = tuple(
-            p if p is not None else jnp.zeros(s, d)
+            p if p is not None else wrap(jnp.zeros(s, d))
             for p, s, d in zip(node.pending, node.out_shapes, node.out_dtypes)
         )
-        if len(cotangents) == 1:
+        if create_graph:
+            in_grads = _taped_vjp(node, cotangents)
+        elif len(cotangents) == 1:
             in_grads = node.vjp_fn(cotangents[0])
         else:
             in_grads = node.vjp_fn(cotangents)
 
         for inp, g in zip(node.inputs, in_grads):
-            if g is None or _is_float0(g) or not _is_float_dtype(inp.dtype):
+            graw = g._data if isinstance(g, Tensor) else g
+            if graw is None or _is_float0(graw) \
+                    or not _is_float_dtype(inp.dtype):
                 pnode = inp._node
                 if pnode is not None:
                     _dec_and_maybe_ready(indegree, pnode, ready)
@@ -151,6 +166,49 @@ def run_backward(tensors, grad_tensors=None, retain_graph=False, leaf_filter=Non
             t._node = None
 
 
+def _taped_vjp(node, cotangents):
+    """create_graph: recompute this node's vjp THROUGH the taped dispatch
+    (`apply`) with the primal Tensors as real inputs, so the produced
+    grads carry a graph reaching both the cotangents and the primals —
+    the re-taping that makes grad-of-grad exact."""
+    from paddle_tpu.core.tensor import apply
+
+    if node.fn is None:
+        # custom nodes (PyLayer) keep their opaque closure: grads flow but
+        # are constant w.r.t. a second differentiation through this node
+        raw = tuple(c._data if isinstance(c, Tensor) else c
+                    for c in cotangents)
+        out = (node.vjp_fn(raw[0]) if len(raw) == 1
+               else node.vjp_fn(raw))
+        return out
+    n_out = node._n_out
+    fmask = [_is_float_dtype(inp.dtype) for inp in node.inputs]
+
+    def sov(*arrs):
+        cots = arrs[:n_out]
+        primals = arrs[n_out:]
+        import jax
+
+        _, vjp = jax.vjp(node.fn, *primals)
+        gs = vjp(cots[0] if n_out == 1 else tuple(cots))
+        kept = tuple(g for g, m in zip(gs, fmask) if m)
+        return kept if len(kept) != 1 else kept[0]
+
+    cot_t = [c if isinstance(c, Tensor) else Tensor(c) for c in cotangents]
+    kept_out = apply(sov, *cot_t, *node.inputs,
+                     _name=f"grad::{node.name}")
+    kept_list = list(kept_out) if isinstance(kept_out, (tuple, list)) \
+        else [kept_out]
+    out, ki = [], 0
+    for m in fmask:
+        if m:
+            out.append(kept_list[ki])
+            ki += 1
+        else:
+            out.append(None)
+    return tuple(out)
+
+
 def _dec_and_maybe_ready(indegree, node, ready):
     indegree[node] = indegree.get(node, 1) - 1
     if indegree[node] <= 0:
@@ -158,6 +216,13 @@ def _dec_and_maybe_ready(indegree, node, ready):
 
 
 def _land_leaf_grad(tensor, g):
+    if isinstance(g, Tensor):  # create_graph: keep the grad's graph alive
+        for hook in list(_tensor_hooks.get(tensor, {}).values()):
+            out = hook(g)
+            if out is not None:
+                g = out if isinstance(out, Tensor) else Tensor(out)
+        tensor.grad = g if tensor.grad is None else tensor.grad + g
+        return
     for hook in list(_tensor_hooks.get(tensor, {}).values()):
         out = hook(Tensor(g))
         if out is not None:
@@ -187,8 +252,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
         t.grad = None
         t.stop_gradient = False
     try:
-        run_backward(list(outputs), grad_outputs, retain_graph=bool(retain_graph),
-                     leaf_filter={id(t) for t in inputs})
+        run_backward(list(outputs), grad_outputs,
+                     retain_graph=bool(retain_graph) or create_graph,
+                     leaf_filter={id(t) for t in inputs},
+                     create_graph=create_graph)
         results = []
         for t in inputs:
             if t.grad is None:

@@ -86,9 +86,10 @@ class GradNode:
     except the backward rule is derived automatically by JAX.
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_shapes", "out_dtypes", "name", "pending", "_n_out")
+    __slots__ = ("vjp_fn", "inputs", "out_shapes", "out_dtypes", "name",
+                 "pending", "_n_out", "fn")
 
-    def __init__(self, vjp_fn, inputs, out_avals, name=""):
+    def __init__(self, vjp_fn, inputs, out_avals, name="", fn=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list[Tensor]
         self.out_shapes = [a.shape for a in out_avals]
@@ -96,6 +97,10 @@ class GradNode:
         self.name = name
         self._n_out = len(out_avals)
         self.pending = None  # accumulated output cotangents during backward
+        # the forward fn over raw arrays: create_graph backward re-tapes
+        # the vjp THROUGH it (d(grad)/d(primal) needs the primal as a real
+        # input, not a closure constant)
+        self.fn = fn
 
     def ensure_pending(self):
         if self.pending is None:
@@ -105,6 +110,7 @@ class GradNode:
         self.vjp_fn = None
         self.inputs = None
         self.pending = None
+        self.fn = None
 
 
 def _is_float_dtype(dt):
@@ -426,7 +432,7 @@ def apply(fn, *tensors, _name="op", _nout=None):
     result = [Tensor(o, stop_gradient=not needs_grad) for o in outs]
 
     if needs_grad:
-        node = GradNode(vjp_fn, list(tensors), outs, name=_name)
+        node = GradNode(vjp_fn, list(tensors), outs, name=_name, fn=fn)
         for i, r in enumerate(result):
             r._node = node
             r._out_idx = i

@@ -20,7 +20,10 @@ __all__ = [
     "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli", "Beta",
     "Gamma", "Dirichlet", "Exponential", "Laplace", "LogNormal",
     "Multinomial", "Poisson", "Geometric", "Cauchy", "Gumbel",
-    "StudentT", "Binomial", "kl_divergence", "register_kl",
+    "StudentT", "Binomial", "Chi2", "ContinuousBernoulli",
+    "ExponentialFamily", "Independent", "LKJCholesky",
+    "MultivariateNormal", "TransformedDistribution",
+    "kl_divergence", "register_kl",
 ]
 
 
@@ -468,3 +471,285 @@ def _kl_bernoulli(p, q):
 def _kl_exponential(p, q):
     r = p.rate / q.rate
     return Tensor(jnp.log(r) + q.rate / p.rate - 1)
+
+
+# -- r5 surface sweep: the remaining reference distribution classes ---------
+
+
+class ExponentialFamily(Distribution):
+    """Natural-parameter base (reference
+    `distribution/exponential_family.py`): subclasses expose
+    _natural_parameters / _log_normalizer; entropy comes from the Bregman
+    identity H = A(eta) - <eta, grad A(eta)> + E[log h(x)] via jax.grad."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nats = [jnp.asarray(n) for n in self._natural_parameters]
+        # grads of the SUM equal the per-element dA/deta (A is elementwise
+        # in eta), but the VALUE must stay per-element — a broadcast of
+        # the summed A would inflate every batched entry
+        a_val = self._log_normalizer(*nats)
+        grads = jax.grad(
+            lambda *ns: jnp.sum(self._log_normalizer(*ns)),
+            argnums=tuple(range(len(nats))))(*nats)
+        ent = (jnp.broadcast_to(a_val, self.batch_shape).astype(jnp.float32)
+               - self._mean_carrier_measure)
+        total = jnp.zeros(self.batch_shape, jnp.float32) + ent
+        for n, g in zip(nats, grads):
+            total = total - n * g
+        return Tensor(total)
+
+
+class Chi2(Gamma):
+    """Chi-squared(df) == Gamma(df/2, 1/2) (reference
+    `distribution/chi2.py`)."""
+
+    def __init__(self, df, name=None):
+        self.df = _data(df).astype(jnp.float32)
+        super().__init__(self.df / 2.0, jnp.full_like(self.df, 0.5))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference `distribution/continuous_bernoulli.py`: density
+    C(p) * p^x (1-p)^(1-x) on [0, 1] with the log-normalizer C(p)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.clip(_data(probs).astype(jnp.float32), 1e-6,
+                              1 - 1e-6)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_C(self):
+        p = self.probs
+        lo, hi = self._lims
+        # log C(p) = log( 2 atanh(1-2p) / (1-2p) ), with the p -> 1/2
+        # limit handled by a Taylor patch inside the cut region
+        safe = jnp.where((p < lo) | (p > hi), p, lo)
+        c = jnp.log(2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe))
+        x = p - 0.5
+        taylor = jnp.log(2.0) + (4.0 / 3) * x * x  # C(1/2+x) ~ 2 + 8x^2/3
+        return jnp.where((p < lo) | (p > hi), c, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs
+        lo, hi = self._lims
+        safe = jnp.where((p < lo) | (p > hi), p, lo)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return Tensor(jnp.where((p < lo) | (p > hi), m,
+                                0.5 + (p - 0.5) / 3))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_rng.next_key(), self._extend(shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        p = self.probs
+        lo, hi = self._lims
+        safe = jnp.where((p < lo) | (p > hi), p, lo)
+        x = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+             / jnp.log(safe / (1 - safe)))
+        return Tensor(jnp.where((p < lo) | (p > hi), x, u))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _data(value)
+        p = self.probs
+        return Tensor(self._log_C() + v * jnp.log(p)
+                      + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        m = self.mean._data
+        p = self.probs
+        return Tensor(-(self._log_C() + m * jnp.log(p)
+                        + (1 - m) * jnp.log1p(-p)))
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference
+    `distribution/independent.py`): log_prob sums over the converted
+    dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        bshape = base.batch_shape
+        super().__init__(bshape[:len(bshape) - self._rank],
+                         bshape[len(bshape) - self._rank:]
+                         + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _sum_tail(self, arr):
+        for _ in range(self._rank):
+            arr = jnp.sum(arr, axis=-1)
+        return arr
+
+    def log_prob(self, value):
+        return Tensor(self._sum_tail(_data(self.base.log_prob(value))))
+
+    def entropy(self):
+        return Tensor(self._sum_tail(_data(self.base.entropy())))
+
+
+class MultivariateNormal(Distribution):
+    """reference `distribution/multivariate_normal.py`: parameterized by
+    covariance / precision / scale_tril; sampling and log_prob ride one
+    Cholesky factor (TPU-friendly triangular ops)."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _data(loc).astype(jnp.float32)
+        given = [a for a in (covariance_matrix, precision_matrix,
+                             scale_tril) if a is not None]
+        if len(given) != 1:
+            raise ValueError("give exactly one of covariance_matrix / "
+                             "precision_matrix / scale_tril")
+        if scale_tril is not None:
+            self._L = _data(scale_tril).astype(jnp.float32)
+        elif covariance_matrix is not None:
+            self._L = jnp.linalg.cholesky(
+                _data(covariance_matrix).astype(jnp.float32))
+        else:
+            prec = _data(precision_matrix).astype(jnp.float32)
+            self._L = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        d = self.loc.shape[-1]
+        super().__init__(self.loc.shape[:-1], (d,))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._L @ jnp.swapaxes(self._L, -1, -2))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(self._L * self._L, axis=-1))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(
+            _rng.next_key(), tuple(shape) + self.loc.shape)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i", self._L,
+                                            eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _data(value).astype(jnp.float32)
+        diff = v - self.loc
+        sol = jax.scipy.linalg.solve_triangular(self._L, diff[..., None],
+                                                lower=True)[..., 0]
+        d = self.loc.shape[-1]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._L, axis1=-2,
+                                              axis2=-1)), -1)
+        return Tensor(-0.5 * jnp.sum(sol * sol, -1) - logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(self._L, axis1=-2,
+                                              axis2=-1)), -1)
+        out = 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+
+
+class TransformedDistribution(Distribution):
+    """base pushed through a chain of Transforms (reference
+    `distribution/transformed_distribution.py`); log_prob subtracts the
+    forward log-det-Jacobians."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = value
+        lp = jnp.zeros((), jnp.float32)
+        for t in reversed(self.transforms):
+            x = t.inverse(v)
+            lp = lp - _data(t.forward_log_det_jacobian(x))
+            v = x
+        return Tensor(_data(self.base.log_prob(v)) + lp)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over correlation-matrix Cholesky factors (reference
+    `distribution/lkj_cholesky.py`): onion-method sampling, density
+    prod_i L_ii^(d - i - 1 + 2(eta - 1)) up to the normalizer."""
+
+    def __init__(self, dim, concentration=1.0,
+                 sample_method="onion", name=None):
+        self.dim = int(dim)
+        self.concentration = _data(concentration).astype(jnp.float32)
+        super().__init__(jnp.shape(self.concentration),
+                         (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = self.concentration
+        key = _rng.next_key()
+        out_shape = tuple(shape) + self.batch_shape
+        # onion method: row i built from a Beta-distributed radius and a
+        # uniform direction on the sphere
+        L = jnp.zeros(out_shape + (d, d), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            key, k1, k2 = jax.random.split(key, 3)
+            beta = jax.random.beta(
+                k1, i / 2.0, eta + (d - 1 - i) / 2.0, out_shape)
+            u = jax.random.normal(k2, out_shape + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(beta)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(1.0 - beta))
+        return Tensor(L)
+
+    def log_prob(self, value):
+        L = _data(value).astype(jnp.float32)
+        d = self.dim
+        eta = self.concentration
+        order = jnp.arange(1, d, dtype=jnp.float32)
+        expo = d - order - 1.0 + 2.0 * (eta[..., None]
+                                        if jnp.ndim(eta) else eta) - 2.0
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        unnorm = jnp.sum(expo * jnp.log(diag), axis=-1)
+        # normalizer (reference lkj_cholesky.py log-normalizer)
+        i = jnp.arange(1, d, dtype=jnp.float32)
+        a = eta + (d - 1 - i) / 2.0
+        lognorm = jnp.sum(
+            0.5 * i * math.log(math.pi)
+            + jax.scipy.special.gammaln(a)
+            - jax.scipy.special.gammaln(a + i / 2.0), axis=-1)
+        return Tensor(unnorm - lognorm)

@@ -298,3 +298,35 @@ def graph_khop_sampler(row, colptr, x, eids=None, sample_sizes=(),
 
 
 khop_sampler = graph_khop_sampler  # python-api name
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-edge-type reindex (reference `geometric/reindex.py`
+    reindex_heter_graph): neighbors/count are PER EDGE TYPE lists sharing
+    one id space; all types reindex against one mapping (x first, then
+    new nodes in first-appearance order across types)."""
+    import numpy as np
+
+    from paddle_tpu.core.tensor import Tensor
+
+    xs = _np1d(x, np.int64)
+    nbs = [_np1d(n, np.int64) for n in neighbors]
+    cts = [_np1d(c, np.int64) for c in count]
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    for nb in nbs:
+        for v in nb:
+            if int(v) not in mapping:
+                mapping[int(v)] = len(mapping)
+    srcs, dsts = [], []
+    for nb, ct in zip(nbs, cts):
+        srcs.append(np.asarray([mapping[int(v)] for v in nb], np.int64))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int64), ct))
+    import jax.numpy as jnp
+
+    out_nodes = np.asarray(sorted(mapping, key=mapping.get), np.int64)
+    return (Tensor(jnp.asarray(np.concatenate(srcs) if srcs
+                               else np.zeros(0, np.int64))),
+            Tensor(jnp.asarray(np.concatenate(dsts) if dsts
+                               else np.zeros(0, np.int64))),
+            Tensor(jnp.asarray(out_nodes)))

@@ -22,7 +22,10 @@ import pickle
 import numpy as np
 
 __all__ = ["Config", "Predictor", "PredictorHandle", "create_predictor",
-           "PrecisionType", "PlaceType", "get_version"]
+           "PrecisionType", "PlaceType", "get_version", "DataType",
+           "Tensor", "PredictorPool", "XpuConfig",
+           "get_num_bytes_of_data_type", "get_trt_compile_version",
+           "get_trt_runtime_version", "convert_to_mixed_precision"]
 
 
 def get_version():
@@ -229,3 +232,105 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+# -- r5 surface sweep: the rest of the reference inference namespace --------
+
+
+class DataType:
+    """reference inference.DataType enum."""
+
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    BOOL = 7
+    FLOAT64 = 8
+
+
+_DTYPE_NBYTES = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+                 DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+                 DataType.BFLOAT16: 2, DataType.BOOL: 1, DataType.FLOAT64: 8}
+
+
+def get_num_bytes_of_data_type(dtype):
+    return _DTYPE_NBYTES[dtype]
+
+
+Tensor = PredictorHandle  # reference inference.Tensor == the io handle
+
+
+class XpuConfig:
+    """Accepted-for-compat XPU knob bag (no XPU on this backend; using it
+    on a Config warns)."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+class PredictorPool:
+    """N independent Predictors over one Config (reference
+    `inference/api/paddle_inference_api.h` PredictorPool: per-thread
+    predictors sharing weights). Each retrieve(i) gets its own handles;
+    the compiled program is shared via PJRT's executable cache."""
+
+    def __init__(self, config, size=1):
+        self._preds = [Predictor(config) for _ in range(max(1, size))]
+
+    def retrieve(self, idx):
+        return self._preds[idx]
+
+
+def get_trt_compile_version():
+    return (0, 0, 0)  # no TensorRT on this backend (XLA replaces it)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kw):
+    """Offline fp32 -> bf16/fp16 weight conversion of a saved predictor
+    (reference `inference/convert_to_mixed_precision`): rewrites the
+    .pdiparams weights; the .pdmodel program is re-exported by jit.save
+    when dtype-exact, so here the weights convert and the program is
+    copied (the Predictor casts feeds per the export's avals)."""
+    import pickle
+    import shutil
+
+    import numpy as np
+
+    targets = {None: np.float16, PrecisionType.Half: np.float16,
+               PrecisionType.Bfloat16: "bfloat16"}
+    if mixed_precision not in targets:
+        raise ValueError(
+            f"convert_to_mixed_precision: unsupported mixed_precision "
+            f"{mixed_precision!r} (Half or Bfloat16)")
+    target = targets[mixed_precision]
+    with open(params_file, "rb") as f:
+        state = pickle.load(f)
+    bl = set(black_list or ())
+    out = {}
+    for k, v in state.items():
+        arr = np.asarray(v)
+        if k not in bl and arr.dtype == np.float32:
+            if target == "bfloat16":
+                import jax.numpy as jnp
+
+                arr = np.asarray(jnp.asarray(arr).astype(jnp.bfloat16))
+            else:
+                arr = arr.astype(target)
+        out[k] = arr
+    with open(mixed_params_file, "wb") as f:
+        pickle.dump(out, f)
+    shutil.copyfile(model_file, mixed_model_file)
+
+
+def _get_phi_kernel_name(op_name):
+    return op_name  # one dispatch waist: the op name IS the kernel name

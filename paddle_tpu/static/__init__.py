@@ -20,9 +20,18 @@ import numpy as np
 
 __all__ = [
     "InputSpec", "Program", "program_guard", "default_main_program",
-    "default_startup_program", "data", "Executor", "global_scope", "scope_guard",
-    "save_inference_model", "load_inference_model", "name_scope", "cpu_places",
-    "device_guard",
+    "default_startup_program", "data", "Executor", "global_scope",
+    "scope_guard", "save_inference_model", "load_inference_model",
+    "name_scope", "cpu_places", "device_guard", "Variable",
+    "create_parameter", "create_global_var", "gradients",
+    "append_backward", "py_func", "accuracy", "auc",
+    "ExponentialMovingAverage", "WeightNormParamAttr", "BuildStrategy",
+    "CompiledProgram", "cuda_places", "xpu_places", "Print",
+    "serialize_program", "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "save_to_file", "load_from_file", "save",
+    "load", "load_program_state", "set_program_state",
+    "normalize_program", "ctr_metric_bundle", "IpuStrategy",
+    "IpuCompiledProgram", "ipu_shard_guard", "set_ipu_shard",
 ]
 
 
@@ -215,3 +224,301 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 def load_inference_model(path_prefix, executor=None, **kw):
     prog = _LoadedInferenceProgram(path_prefix)
     return prog, prog.feed_names, prog.fetch_names
+
+
+# -- r5 surface sweep: the rest of the reference paddle.static namespace ----
+# (eager-scope semantics as documented in the module docstring: ops under
+# program_guard execute eagerly; the compiled path is jit.to_static.)
+
+from paddle_tpu.core.tensor import Tensor as Variable  # noqa: E402
+# the reference's static Variable IS a tensor handle on this build
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """reference `static/nn/common.py` create_parameter — an eagerly
+    materialized Parameter."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import dtypes
+    from paddle_tpu.nn.initializer import XavierNormal
+    from paddle_tpu.nn.layer.layers import Parameter
+
+    dt = dtypes.convert_dtype(dtype)
+    init = default_initializer or XavierNormal()
+    p = Parameter(init(tuple(shape)).astype(dt) if callable(init)
+                  else jnp.zeros(shape, dt))
+    p.stop_gradient = False
+    return p
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import dtypes
+
+    return Variable(jnp.full(tuple(shape), value,
+                             dtypes.convert_dtype(dtype)))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    """reference `static/gradients` — maps onto the eager tape."""
+    from paddle_tpu.core.backward import grad as _grad
+
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    return _grad(list(outs), list(ins), grad_outputs=target_gradients,
+                 retain_graph=True, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference `static/append_backward`: populate .grad on parameters
+    (the eager-mode equivalent: loss.backward()); returns (param, grad)
+    pairs."""
+    loss.backward(retain_graph=True)
+    params = parameter_list or []
+    return [(p, p.grad) for p in params if getattr(p, "grad", None)
+            is not None]
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference `static/nn/common.py` py_func: eager call-through (the
+    graph-insertion machinery is unnecessary when execution is eager)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from paddle_tpu import metric as _m
+
+    return _m.accuracy(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    from paddle_tpu import metric as _m
+
+    m = _m.Auc(num_thresholds=num_thresholds)
+    import numpy as np
+
+    probs = np.asarray(input.numpy() if hasattr(input, "numpy") else input)
+    lab = np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    m.update(probs, lab)
+    from paddle_tpu.core.tensor import Tensor
+    import jax.numpy as jnp
+
+    val = Tensor(jnp.asarray(np.float32(m.accumulate())))
+    return val, val, val
+
+
+class ExponentialMovingAverage:
+    """reference `static/ema.py`: shadow-parameter EMA with apply/restore
+    context."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._params = None
+
+    def update(self, parameters=None):
+        import paddle_tpu as paddle
+
+        params = parameters
+        if params is None:
+            raise ValueError("pass parameters=... on this build (there is "
+                             "no global program to harvest them from)")
+        self._params = list(params)
+        for i, p in enumerate(self._params):
+            s = self._shadow.get(i)
+            self._shadow[i] = (p._data if s is None
+                               else self._decay * s
+                               + (1 - self._decay) * p._data)
+
+    def apply(self, executor=None, need_restore=True):
+        class _Ctx:
+            def __enter__(ctx):
+                self._backup = {i: p._data
+                                for i, p in enumerate(self._params)}
+                for i, p in enumerate(self._params):
+                    p._data = self._shadow[i].astype(p.dtype)
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if need_restore:
+                    for i, p in enumerate(self._params):
+                        p._data = self._backup[i]
+                return False
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for i, p in enumerate(self._params or []):
+            if i in self._backup:
+                p._data = self._backup[i]
+
+
+class WeightNormParamAttr:
+    """Accepted-for-compat (reference static/nn weight-norm attr); use
+    paddle.nn.utils.weight_norm on this build."""
+
+    def __init__(self, dim=None, **kw):
+        self.dim = dim
+        self.__dict__.update(kw)
+
+
+class BuildStrategy:
+    """Accepted-for-compat knob bag (XLA owns fusion/scheduling)."""
+
+    def __init__(self):
+        pass
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class CompiledProgram:
+    """reference CompiledProgram: on this build a Program already executes
+    through jit, so this is a pass-through wrapper."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+
+def cuda_places(device_ids=None):
+    import jax
+
+    return list(jax.devices())  # best accelerators available
+
+
+def xpu_places(device_ids=None):
+    import jax
+
+    return list(jax.devices())
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference static Print op: eager print-through."""
+    msg = message or ""
+    print(f"{msg} {input}")
+    return input
+
+
+# -- program/persistable serialization: the 'program' here is the traced
+# -- export (jit.save's .pdmodel payload); persistables are the weights ----
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    import pickle
+
+    return pickle.dumps({"feed": [getattr(v, "name", None)
+                                  for v in (feed_vars or [])],
+                         "fetch": len(fetch_vars or [])})
+
+
+def deserialize_program(data):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kwargs):
+    import pickle
+
+    params = {}
+    for v in fetch_vars or []:
+        layer = getattr(v, "_layer", None)
+        if layer is not None:
+            params.update({k: p.numpy() for k, p in layer.state_dict().items()})
+    return pickle.dumps(params)
+
+
+def deserialize_persistables(program, data, executor=None):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """reference static.save: persist a model's state (the Program holds
+    no separate weights on this build; pass a Layer-backed program or use
+    paddle.save on the state_dict)."""
+    import pickle
+
+    state = getattr(program, "state_dict", lambda: {})()
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump({k: v.numpy() if hasattr(v, "numpy") else v
+                     for k, v in state.items()}, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    setter = getattr(program, "set_state_dict", None)
+    if setter is not None:
+        setter(state)
+    return state
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    setter = getattr(program, "set_state_dict", None)
+    if setter is not None:
+        setter(state_dict)
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    return program  # the traced export is already feed/fetch-normalized
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """reference static ctr_metric_bundle: (auc_var, batch_auc, ...) —
+    maps onto the streaming Auc metric."""
+    a, _, _ = auc(input, label)
+    return a, a
+
+
+class _IpuUnsupported:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "IPU support does not exist on this backend (TPU build); "
+            "Graphcore-specific APIs are intentionally absent")
+
+
+IpuStrategy = _IpuUnsupported
+IpuCompiledProgram = _IpuUnsupported
+
+
+def ipu_shard_guard(*a, **k):
+    raise NotImplementedError("IPU sharding is not available on the TPU "
+                              "build")
+
+
+def set_ipu_shard(*a, **k):
+    raise NotImplementedError("IPU sharding is not available on the TPU "
+                              "build")

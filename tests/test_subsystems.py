@@ -875,3 +875,103 @@ def test_profiler_chrome_trace_export(tmp_path):
     assert "add" in names  # host op dispatch
     cats = {e.get("cat") for e in trace["traceEvents"] if e.get("ph") == "X"}
     assert "op" in cats
+
+
+def test_namespace_surface_parity():
+    """Every name in the reference's python __all__ for these namespaces
+    resolves here (r5 surface sweep: 'a user switching finds everything
+    they need')."""
+    import ast
+    import importlib
+
+    REF = "/root/reference/python/paddle"
+
+    def ref_all(mod):
+        p = os.path.join(REF, mod, "__init__.py")
+        tree = ast.parse(open(p).read())
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if getattr(t, "id", None) == "__all__":
+                        return set(ast.literal_eval(node.value))
+        return set()
+
+    for name in ["io", "static", "metric", "amp", "autograd", "sparse",
+                 "distribution", "geometric", "jit", "inference",
+                 "optimizer"]:
+        ra = ref_all(name)
+        ours = importlib.import_module(f"paddle_tpu.{name}")
+        missing = sorted(n for n in ra if not hasattr(ours, n))
+        assert not missing, f"paddle.{name} missing {missing}"
+
+
+def test_double_backward_and_new_optimizers():
+    """create_graph double backward (re-taped vjps) + the r5 optimizers
+    descend on a quadratic."""
+    from paddle_tpu import autograd
+
+    x = paddle.to_tensor([2.0])
+    x.stop_gradient = False
+    y = x * x * x
+    g = paddle.grad([y], [x], create_graph=True)[0]
+    np.testing.assert_allclose(g.numpy(), [12.0])
+    g2 = paddle.grad([g], [x])[0]
+    np.testing.assert_allclose(g2.numpy(), [12.0])  # 6x
+
+    x2 = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    x2.stop_gradient = False
+    z = (x2[0] ** 3 + x2[0] * x2[1] * x2[1]).sum()
+    H = autograd.hessian(z, x2)
+    np.testing.assert_allclose(H.numpy(), [[6, 4], [4, 2]], atol=1e-5)
+
+    def run(opt_cls, **kw):
+        paddle.seed(0)
+        layer = nn.Linear(8, 1)
+        opt = opt_cls(parameters=layer.parameters(), **kw)
+        x = paddle.ones([16, 8])
+        first = last = None
+        for _ in range(25):
+            loss = (layer(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        return first, last
+
+    for cls, kw in [(paddle.optimizer.Rprop, dict(learning_rate=0.01)),
+                    (paddle.optimizer.ASGD,
+                     dict(learning_rate=0.05, batch_num=4)),
+                    (paddle.optimizer.NAdam, dict(learning_rate=0.05)),
+                    (paddle.optimizer.RAdam, dict(learning_rate=0.05))]:
+        a, b = run(cls, **kw)
+        assert b < a * 0.5, (cls.__name__, a, b)
+
+    paddle.seed(0)
+    layer = nn.Linear(4, 1)
+    opt = paddle.optimizer.LBFGS(parameters=layer.parameters(),
+                                 line_search_fn="strong_wolfe")
+    xx = paddle.ones([8, 4])
+
+    def closure():
+        loss = (layer(xx) ** 2).mean()
+        loss.backward()
+        return loss
+
+    l0 = float(closure().numpy())
+    loss = opt.step(closure)
+    assert float(loss.numpy()) < l0 * 1e-3
+
+
+def test_jacobian_batch_axis():
+    """batch_axis=0 returns the per-sample block-diagonal [B, M, N], not a
+    reshape of the dense matrix (review finding)."""
+    from paddle_tpu import autograd
+
+    x = paddle.to_tensor(np.array([[1., 2], [3, 4]], "float32"))
+    x.stop_gradient = False
+    y = x * x  # dy[b,i]/dx[b,j] = diag(2x[b])
+    J = autograd.jacobian(y, x, batch_axis=0)
+    assert J.shape == [2, 2, 2]
+    np.testing.assert_allclose(J.numpy()[0], np.diag([2., 4]), atol=1e-6)
+    np.testing.assert_allclose(J.numpy()[1], np.diag([6., 8]), atol=1e-6)
