@@ -48,6 +48,8 @@ from paddle_tpu.ops.linalg import *  # noqa: F401,F403
 from paddle_tpu.ops.logic import *  # noqa: F401,F403
 from paddle_tpu.ops.search import *  # noqa: F401,F403
 from paddle_tpu.ops.legacy_ps import *  # noqa: F401,F403
+from paddle_tpu.ops.extras import *  # noqa: F401,F403
+from paddle_tpu.ops.extras import t_alias as _t_alias  # noqa: E402
 
 from paddle_tpu.core import ops_patch as _ops_patch
 
@@ -66,6 +68,15 @@ from paddle_tpu import hapi  # noqa: F401,E402
 from paddle_tpu.hapi.model import Model  # noqa: F401,E402
 from paddle_tpu.framework.io import save, load  # noqa: F401,E402
 from paddle_tpu.nn.layer.layers import ParamAttr  # noqa: F401,E402
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from paddle_tpu import static as _static
+
+    return _static.create_parameter(shape, dtype, name=name, attr=attr,
+                                    is_bias=is_bias,
+                                    default_initializer=default_initializer)
 
 # paddle.DataParallel / paddle.distributed etc. are imported lazily to avoid
 # pulling heavy stacks at import time
@@ -90,3 +101,9 @@ def __getattr__(name):
 
 
 __version__ = "0.1.0"
+
+from paddle_tpu.core.ops_patch import \
+    _install_inplace_variants as _iiv  # noqa: E402
+
+_iiv()
+del _iiv

@@ -185,16 +185,18 @@ def hessian(ys, xs, batch_axis=None):
     gs = _grad([y], list(xs_list), retain_graph=True, create_graph=True,
                allow_unused=True)
 
-    def jac_or_zero(g, x):
-        if g is None:  # y independent of this x: a zero block
+    def jac_or_zero(g, xi, xj):
+        if g is None:  # y independent of x_i: block (i, j) is zeros
             from paddle_tpu.core.tensor import Tensor
             import jax.numpy as jnp
 
-            n = int(np.prod(x.shape)) if x.ndim else 1
-            return Tensor(jnp.zeros((n, n), x.dtype))
-        return jacobian(g, x)
+            ni = int(np.prod(xi.shape)) if xi.ndim else 1
+            nj = int(np.prod(xj.shape)) if xj.ndim else 1
+            return Tensor(jnp.zeros((ni, nj), xj.dtype))
+        return jacobian(g, xj)
 
-    outs = [[jac_or_zero(g, x) for x in xs_list] for g in gs]
+    outs = [[jac_or_zero(g, xi, xj) for xj in xs_list]
+            for g, xi in zip(gs, xs_list)]
     if isinstance(xs, (list, tuple)):
         return outs
     return outs[0][0]

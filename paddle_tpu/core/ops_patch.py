@@ -128,3 +128,126 @@ def install():
     for name, fn in method_table.items():
         if fn is not None and not hasattr(T, name):
             setattr(T, name, fn)
+
+
+def _install_inplace_variants():
+    """Generate the reference's `op_` in-place variants (r5 surface sweep;
+    reference `python/paddle/tensor/` inplace APIs, generated from the
+    same yaml): `x.op_(...)`/`paddle.op_(x, ...)` computes op and rebinds
+    x's storage — under XLA "in-place" is a rebind, donation makes it
+    zero-copy where possible. Also the in-place RANDOM fills
+    (bernoulli_/normal_/uniform_/cauchy_/geometric_/exponential_/
+    log_normal_)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.tensor import Tensor
+
+    names = [
+        "abs", "acos", "asin", "atan", "ceil", "clip", "cos", "cosh",
+        "cumprod", "cumsum", "digamma", "divide", "equal", "erf", "exp",
+        "expm1", "flatten", "floor", "floor_divide", "floor_mod", "frac",
+        "gammainc", "gammaincc", "gammaln", "gcd", "greater_equal",
+        "greater_than", "hypot", "i0", "index_add", "index_fill",
+        "index_put", "lcm", "ldexp", "less_equal", "less_than", "lgamma",
+        "log", "log10", "log1p", "log2", "logical_and", "logical_not",
+        "logical_or", "logical_xor", "logit", "masked_fill",
+        "masked_scatter", "maximum", "minimum", "mod", "multigammaln",
+        "multiply", "nan_to_num", "neg", "not_equal", "polygamma", "pow",
+        "put_along_axis", "reciprocal", "remainder", "renorm", "round",
+        "rsqrt", "scale", "scatter", "sigmoid", "sign", "sin", "sinc",
+        "sinh", "sqrt", "square", "squeeze", "stanh", "subtract", "t",
+        "tan", "tanh", "tril", "triu", "trunc", "unsqueeze", "where",
+        "add", "bitwise_and", "bitwise_invert", "bitwise_left_shift",
+        "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
+        "copysign", "erfinv", "fill_diagonal", "flip", "lerp", "less",
+        "reshape", "transpose", "unique", "addmm", "baddbmm",
+    ]
+
+    def make_inplace(base_fn):
+        def op_(x, *args, **kwargs):
+            # record the op against a SNAPSHOT of x: rebinding x's node to
+            # the new op while the op's recorded input is x itself would
+            # make the node its own ancestor (backward cycle)
+            snap = Tensor(x._data, stop_gradient=x.stop_gradient)
+            snap._node = x._node
+            snap._out_idx = x._out_idx
+            out = base_fn(snap, *args, **kwargs)
+            out_t = out[0] if isinstance(out, (tuple, list)) else out
+            if out_t._data.dtype != x._data.dtype:
+                raise ValueError(
+                    f"in-place {base_fn.__name__}_ would change dtype "
+                    f"{x.dtype} -> {out_t._data.dtype}; use the "
+                    "out-of-place form")
+            # rebind data AND the grad node: backward must flow through
+            # the recorded op, not x's stale pre-op node
+            x._data = out_t._data
+            x._node = out_t._node
+            x._out_idx = out_t._out_idx
+            if not out_t.stop_gradient:
+                x.stop_gradient = False
+            return x
+
+        return op_
+
+    for nm in names:
+        base = getattr(paddle, nm, None)
+        if base is None or hasattr(paddle, nm + "_"):
+            continue
+        fn = make_inplace(base)
+        fn.__name__ = nm + "_"
+        setattr(paddle, nm + "_", fn)
+        if not hasattr(Tensor, nm + "_"):
+            setattr(Tensor, nm + "_", fn)
+
+    # in-place random fills (reference tensor/random.py *_ APIs)
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.framework import random as _rng
+
+    def _fill(x, sampler):
+        x._data = sampler(_rng.next_key(), x._data.shape).astype(x.dtype)
+        return x
+
+    def bernoulli_(x, p=0.5, name=None):
+        return _fill(x, lambda k, s: (jax.random.uniform(k, s) < p))
+
+    def normal_(x, mean=0.0, std=1.0, name=None):
+        return _fill(x, lambda k, s: mean + std * jax.random.normal(k, s))
+
+    def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+        return _fill(x, lambda k, s: jax.random.uniform(
+            k, s, minval=min, maxval=max))
+
+    def cauchy_(x, loc=0, scale=1, name=None):
+        return _fill(x, lambda k, s: loc + scale * jax.random.cauchy(k, s))
+
+    def geometric_(x, probs, name=None):
+        return _fill(x, lambda k, s: jax.random.geometric(k, probs, s))
+
+    def exponential_(x, lam=1.0, name=None):
+        return _fill(x, lambda k, s: jax.random.exponential(k, s) / lam)
+
+    def log_normal_(x, mean=1.0, std=2.0, name=None):
+        return _fill(x, lambda k, s: jnp.exp(
+            mean + std * jax.random.normal(k, s)))
+
+    def log_normal(mean=1.0, std=2.0, shape=None, dtype="float32",
+                   name=None):
+        from paddle_tpu.framework import dtypes
+
+        out = jnp.exp(mean + std * jax.random.normal(
+            _rng.next_key(), tuple(shape or ())))
+        return Tensor(out.astype(dtypes.convert_dtype(dtype)))
+
+    for fn in (bernoulli_, normal_, uniform_, cauchy_, geometric_,
+               exponential_, log_normal_):
+        if not hasattr(paddle, fn.__name__):
+            setattr(paddle, fn.__name__, fn)
+        if not hasattr(Tensor, fn.__name__):
+            setattr(Tensor, fn.__name__, fn)
+    if not hasattr(paddle, "log_normal"):
+        paddle.log_normal = log_normal
+    if not hasattr(paddle, "t_"):
+        from paddle_tpu.ops.extras import t_alias
+
+        paddle.t_ = make_inplace(t_alias)

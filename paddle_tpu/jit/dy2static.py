@@ -1275,9 +1275,14 @@ def convert_function(fn, skip_regions=None):
         from paddle_tpu import jit as _jit_mod
 
         if getattr(_jit_mod, "_code_level", 0) > 0:
-            # paddle.jit.set_code_level: dump the converted source
-            print(f"[dy2static] converted {ns_key}:\n"
-                  + ast.unparse(new_tree))
+            # paddle.jit.set_code_level: dump the converted source. A
+            # dump failure must not discard the successful conversion.
+            try:
+                print(f"[dy2static] converted {ns_key}:\n"
+                      + ast.unparse(new_tree))
+            except Exception as dump_err:  # pragma: no cover
+                print(f"[dy2static] converted {ns_key} "
+                      f"(source dump failed: {dump_err})")
     except (OSError, TypeError, SyntaxError, ValueError, IndentationError,
             AttributeError, KeyError):
         return fn

@@ -529,7 +529,10 @@ class LBFGS(Optimizer):
                                 for a in arrs])
 
     def _gather_grads(self):
-        return self._flat([p.grad._data for p in self._parameter_list])
+        return self._flat([
+            p.grad._data if p.grad is not None
+            else jnp.zeros_like(p._data)  # unused param: zero direction
+            for p in self._parameter_list])
 
     def _set_params(self, flat):
         i = 0

@@ -904,6 +904,22 @@ def test_namespace_surface_parity():
         missing = sorted(n for n in ra if not hasattr(ours, n))
         assert not missing, f"paddle.{name} missing {missing}"
 
+    # the top level itself: all 441 reference __all__ names resolve
+    tree = ast.parse(open(os.path.join(REF, "__init__.py")).read())
+    ra = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    ra = set(ast.literal_eval(node.value))
+    missing = sorted(n for n in ra if not hasattr(paddle, n))
+    assert not missing, f"paddle top-level missing {missing}"
+    # the inplace variants really mutate in place
+    xi = paddle.to_tensor(np.array([4.0], "float32"))
+    ref_id = id(xi)
+    xi.sqrt_()
+    assert id(xi) == ref_id and float(xi.numpy()[0]) == 2.0
+
 
 def test_double_backward_and_new_optimizers():
     """create_graph double backward (re-taped vjps) + the r5 optimizers
