@@ -49,7 +49,6 @@ from paddle_tpu.ops.logic import *  # noqa: F401,F403
 from paddle_tpu.ops.search import *  # noqa: F401,F403
 from paddle_tpu.ops.legacy_ps import *  # noqa: F401,F403
 from paddle_tpu.ops.extras import *  # noqa: F401,F403
-from paddle_tpu.ops.extras import t_alias as _t_alias  # noqa: E402
 
 from paddle_tpu.core import ops_patch as _ops_patch
 

@@ -130,6 +130,37 @@ def install():
             setattr(T, name, fn)
 
 
+def make_inplace(base_fn, allow_dtype_change=False):
+    """Build an in-place `op_` variant of `base_fn` (shared by the
+    generated tensor variants below and nn.functional's activation `op_`
+    forms). Records the op against a SNAPSHOT of x — rebinding x's node
+    to the new op while the op's recorded input is x itself would make
+    the node its own ancestor (backward cycle) — then rebinds x's data
+    AND grad node so backward flows through the recorded op, not x's
+    stale pre-op node."""
+    from paddle_tpu.core.tensor import Tensor
+
+    def op_(x, *args, **kwargs):
+        snap = Tensor(x._data, stop_gradient=x.stop_gradient)
+        snap._node = x._node
+        snap._out_idx = x._out_idx
+        out = base_fn(snap, *args, **kwargs)
+        out_t = out[0] if isinstance(out, (tuple, list)) else out
+        if not allow_dtype_change and out_t._data.dtype != x._data.dtype:
+            raise ValueError(
+                f"in-place {base_fn.__name__}_ would change dtype "
+                f"{x.dtype} -> {out_t._data.dtype}; use the "
+                "out-of-place form")
+        x._data = out_t._data
+        x._node = out_t._node
+        x._out_idx = out_t._out_idx
+        if not out_t.stop_gradient:
+            x.stop_gradient = False
+        return x
+
+    return op_
+
+
 def _install_inplace_variants():
     """Generate the reference's `op_` in-place variants (r5 surface sweep;
     reference `python/paddle/tensor/` inplace APIs, generated from the
@@ -155,48 +186,42 @@ def _install_inplace_variants():
         "put_along_axis", "reciprocal", "remainder", "renorm", "round",
         "rsqrt", "scale", "scatter", "sigmoid", "sign", "sin", "sinc",
         "sinh", "sqrt", "square", "squeeze", "stanh", "subtract", "t",
-        "tan", "tanh", "tril", "triu", "trunc", "unsqueeze", "where",
+        "tan", "tanh", "tril", "triu", "trunc", "unsqueeze",
         "add", "bitwise_and", "bitwise_invert", "bitwise_left_shift",
         "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
         "copysign", "erfinv", "fill_diagonal", "flip", "lerp", "less",
         "reshape", "transpose", "unique", "addmm", "baddbmm",
     ]
 
-    def make_inplace(base_fn):
-        def op_(x, *args, **kwargs):
-            # record the op against a SNAPSHOT of x: rebinding x's node to
-            # the new op while the op's recorded input is x itself would
-            # make the node its own ancestor (backward cycle)
-            snap = Tensor(x._data, stop_gradient=x.stop_gradient)
-            snap._node = x._node
-            snap._out_idx = x._out_idx
-            out = base_fn(snap, *args, **kwargs)
-            out_t = out[0] if isinstance(out, (tuple, list)) else out
-            if out_t._data.dtype != x._data.dtype:
-                raise ValueError(
-                    f"in-place {base_fn.__name__}_ would change dtype "
-                    f"{x.dtype} -> {out_t._data.dtype}; use the "
-                    "out-of-place form")
-            # rebind data AND the grad node: backward must flow through
-            # the recorded op, not x's stale pre-op node
-            x._data = out_t._data
-            x._node = out_t._node
-            x._out_idx = out_t._out_idx
-            if not out_t.stop_gradient:
-                x.stop_gradient = False
-            return x
-
-        return op_
-
+    # these write a bool result in place of a numeric input; under the
+    # rebind storage model a dtype change is well-defined, so the guard
+    # is lifted for them (reference tensor/logic.py *_ variants)
+    bool_out = {
+        "equal", "not_equal", "greater_than", "greater_equal",
+        "less_than", "less_equal", "less", "logical_and", "logical_or",
+        "logical_not", "logical_xor",
+    }
     for nm in names:
         base = getattr(paddle, nm, None)
         if base is None or hasattr(paddle, nm + "_"):
             continue
-        fn = make_inplace(base)
+        fn = make_inplace(base, allow_dtype_change=nm in bool_out)
         fn.__name__ = nm + "_"
         setattr(paddle, nm + "_", fn)
         if not hasattr(Tensor, nm + "_"):
             setattr(Tensor, nm + "_", fn)
+
+    def where_(condition, x, y, name=None):
+        """In-place where: mutates X (the second argument), not the
+        condition — the generated variant would rebind arg 0."""
+        inner = make_inplace(
+            lambda xx, cond, yy: paddle.where(cond, xx, yy))
+        return inner(x, condition, y)
+
+    paddle.where_ = where_
+    if not hasattr(Tensor, "where_"):
+        Tensor.where_ = lambda x, condition, y, name=None: where_(
+            condition, x, y)
 
     # in-place random fills (reference tensor/random.py *_ APIs)
     import jax
@@ -205,8 +230,8 @@ def _install_inplace_variants():
     from paddle_tpu.framework import random as _rng
 
     def _fill(x, sampler):
-        x._data = sampler(_rng.next_key(), x._data.shape).astype(x.dtype)
-        return x
+        return x._refill(
+            sampler(_rng.next_key(), x._data.shape).astype(x.dtype))
 
     def bernoulli_(x, p=0.5, name=None):
         return _fill(x, lambda k, s: (jax.random.uniform(k, s) < p))
@@ -215,6 +240,10 @@ def _install_inplace_variants():
         return _fill(x, lambda k, s: mean + std * jax.random.normal(k, s))
 
     def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+        if seed:
+            return x._refill(jax.random.uniform(
+                jax.random.key(seed), x._data.shape, minval=min,
+                maxval=max).astype(x.dtype))
         return _fill(x, lambda k, s: jax.random.uniform(
             k, s, minval=min, maxval=max))
 
@@ -247,7 +276,4 @@ def _install_inplace_variants():
             setattr(Tensor, fn.__name__, fn)
     if not hasattr(paddle, "log_normal"):
         paddle.log_normal = log_normal
-    if not hasattr(paddle, "t_"):
-        from paddle_tpu.ops.extras import t_alias
 
-        paddle.t_ = make_inplace(t_alias)

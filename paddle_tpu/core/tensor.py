@@ -266,31 +266,35 @@ class Tensor:
 
     clear_gradient = clear_grad
 
-    def zero_(self):
-        self._data = jnp.zeros_like(self._data)
+    def _refill(self, data):
+        # a fill erases this tensor's history: keeping the old grad node
+        # would send backward through the pre-fill op with the new data
+        self._data = data
+        self._node = None
+        self._out_idx = 0
         return self
 
+    def zero_(self):
+        return self._refill(jnp.zeros_like(self._data))
+
     def fill_(self, value):
-        self._data = jnp.full_like(self._data, value)
-        return self
+        return self._refill(jnp.full_like(self._data, value))
 
     # -- in-place RNG refills (reference gaussian_inplace / uniform_inplace
     #    / exponential_ kernels) -------------------------------------------
     def normal_(self, mean=0.0, std=1.0):
         from paddle_tpu.framework import random as _rng
 
-        self._data = (mean + std * jax.random.normal(
-            _rng.next_key(), self._data.shape)).astype(self._data.dtype)
-        return self
+        return self._refill((mean + std * jax.random.normal(
+            _rng.next_key(), self._data.shape)).astype(self._data.dtype))
 
     def uniform_(self, min=-1.0, max=1.0, seed=0):
         from paddle_tpu.framework import random as _rng
 
         key = jax.random.key(seed) if seed else _rng.next_key()
-        self._data = jax.random.uniform(
+        return self._refill(jax.random.uniform(
             key, self._data.shape, minval=min,
-            maxval=max).astype(self._data.dtype)
-        return self
+            maxval=max).astype(self._data.dtype))
 
     def exponential_(self, lam=1.0):
         from paddle_tpu.ops.creation import exponential_ as _exp

@@ -41,6 +41,7 @@ from paddle_tpu.nn.layer.transformer import (  # noqa: F401
 from paddle_tpu.nn.layer.rnn import (  # noqa: F401
     SimpleRNN, LSTM, GRU, SimpleRNNCell, LSTMCell, GRUCell,
 )
+from paddle_tpu.nn.layer.extras import *  # noqa: F401,F403
 
 from paddle_tpu.nn import functional  # noqa: F401
 from paddle_tpu.nn import initializer  # noqa: F401

@@ -14,3 +14,4 @@ from paddle_tpu.nn.functional.flash_attention import (  # noqa: F401
     scaled_dot_product_attention,
     sdp_kernel,
 )
+from paddle_tpu.nn.functional.extra_fns import *  # noqa: F401,F403,E402

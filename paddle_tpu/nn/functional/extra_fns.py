@@ -1,0 +1,302 @@
+"""nn.functional completion (r5 surface sweep): the reference
+`python/paddle/nn/functional/__init__.py` members not covered elsewhere —
+losses, pooling variants, in-place activations, attention variants.
+Reference implementations: `python/paddle/nn/functional/{loss,pooling,
+activation,flash_attention}.py`."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, apply
+
+__all__ = [
+    "pairwise_distance", "poisson_nll_loss", "gaussian_nll_loss",
+    "soft_margin_loss", "multi_label_soft_margin_loss",
+    "multi_margin_loss", "triplet_margin_with_distance_loss",
+    "adaptive_log_softmax_with_loss", "feature_alpha_dropout",
+    "lp_pool1d", "elu_", "hardtanh_", "leaky_relu_", "tanh_",
+    "thresholded_relu_", "class_center_sample", "flashmask_attention",
+    "sparse_attention",
+]
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """reference F.pairwise_distance: ||x - y + eps||_p along the last
+    dim."""
+    return apply(
+        lambda a, b: jnp.linalg.norm(a - b + epsilon, ord=p, axis=-1,
+                                     keepdims=keepdim),
+        x, y, _name="pairwise_distance")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(inp, lab):
+        if log_input:
+            loss = jnp.exp(inp) - lab * inp
+        else:
+            loss = inp - lab * jnp.log(inp + epsilon)
+        if full:
+            # Stirling approximation of log(label!)
+            stir = (lab * jnp.log(lab) - lab
+                    + 0.5 * jnp.log(2 * math.pi * lab))
+            loss = loss + jnp.where(lab > 1, stir, 0.0)
+        return _reduce(loss, reduction)
+
+    return apply(fn, input, label, _name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, lab, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (lab - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+
+    return apply(fn, input, label, variance, _name="gaussian_nll_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply(
+        lambda a, t: _reduce(jnp.log1p(jnp.exp(-t * a)), reduction),
+        input, label, _name="soft_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def fn(a, t, *w):
+        loss = -(t * jax.nn.log_sigmoid(a)
+                 + (1 - t) * jax.nn.log_sigmoid(-a))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss.mean(axis=-1), reduction)
+
+    args = [weight] if weight is not None else []
+    return apply(fn, input, label, *args,
+                 _name="multi_label_soft_margin_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def fn(a, t, *w):
+        t = t.astype(jnp.int32)
+        true_score = jnp.take_along_axis(a, t[:, None], axis=1)
+        diff = jnp.maximum(margin - true_score + a, 0.0) ** p
+        if w:
+            diff = diff * jnp.take(w[0], t)[:, None]
+        C = a.shape[1]
+        mask = jax.nn.one_hot(t, C) == 0
+        loss = jnp.where(mask, diff, 0.0).sum(axis=1) / C
+        return _reduce(loss, reduction)
+
+    args = [weight] if weight is not None else []
+    return apply(fn, input, label, *args, _name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or (
+        lambda a, b: pairwise_distance(a, b))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn2 = dist(positive, negative)
+        from paddle_tpu.ops.math import minimum
+
+        dn = minimum(dn, dn2)
+    out = apply(lambda p_, n_: jnp.maximum(p_ - n_ + margin, 0.0),
+                dp, dn, _name="triplet_margin_with_distance")
+    return apply(lambda o: _reduce(o, reduction), out, _name="reduce")
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference F.adaptive_log_softmax_with_loss;
+    Grave et al. 2017): frequent classes in the head, rare classes in
+    down-projected tail clusters. Returns (per-sample output logprob,
+    scalar mean loss)."""
+    # cutoffs includes the total class count: clusters are
+    # [cutoffs[i], cutoffs[i+1]) and the head has shortlist + n_clusters
+    # columns (one routing logit per cluster)
+    shortlist = cutoffs[0]
+    bounds = list(zip(cutoffs[:-1], cutoffs[1:]))
+    has_bias = head_bias is not None
+    flat_tails = [w for pair in tail_weights for w in pair]
+
+    def fn(x, lab, hw, *rest):
+        lab = lab.astype(jnp.int32)
+        hb = rest[0] if has_bias else None
+        tails = rest[1 if has_bias else 0:]
+        head_logits = x @ hw + (hb if hb is not None else 0.0)
+        head_lp = jax.nn.log_softmax(head_logits, axis=-1)
+        in_short = lab < shortlist
+        out = jnp.take_along_axis(
+            head_lp, jnp.clip(lab, 0, shortlist - 1)[:, None], axis=1)[:, 0]
+        out = jnp.where(in_short, out, 0.0)
+        for ci, (lo, hi) in enumerate(bounds):
+            w1, w2 = tails[2 * ci], tails[2 * ci + 1]
+            tail_lp = jax.nn.log_softmax((x @ w1) @ w2, axis=-1)
+            in_c = (lab >= lo) & (lab < hi)
+            idx = jnp.clip(lab - lo, 0, tail_lp.shape[1] - 1)
+            lp = head_lp[:, shortlist + ci] + jnp.take_along_axis(
+                tail_lp, idx[:, None], axis=1)[:, 0]
+            out = jnp.where(in_c, lp, out)
+        return out, -out.mean()
+
+    args = [input, label, head_weight]
+    if has_bias:
+        args.append(head_bias)
+    return apply(fn, *args, *flat_tails,
+                 _name="adaptive_log_softmax_with_loss")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole feature maps (reference
+    F.feature_alpha_dropout): SELU-compatible noise applied per channel."""
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    from paddle_tpu.framework import random as _rng
+
+    alpha_p = -1.7580993408473766
+
+    def fn(a):
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(_rng.next_key(), 1 - p, shape)
+        A = (1 - p + p * alpha_p ** 2) ** -0.5
+        B = -A * p * alpha_p
+        return A * jnp.where(keep, a, alpha_p) + B
+
+    return apply(fn, x, _name="feature_alpha_dropout")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """reference F.lp_pool1d: power-mean pooling over 1-D windows."""
+    def fn(a):
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        s = stride if stride is not None else k
+        s = s if isinstance(s, int) else s[0]
+        powed = jnp.abs(a) ** norm_type
+        summed = jax.lax.reduce_window(
+            powed, 0.0, jax.lax.add, (1, 1, k), (1, 1, s),
+            [(0, 0), (0, 0), (padding, padding)])
+        return summed ** (1.0 / norm_type)
+
+    return apply(fn, x, _name="lp_pool1d")
+
+
+def _inplace_act(fn_name):
+    from paddle_tpu.core.ops_patch import make_inplace
+    from paddle_tpu.nn.functional import activation as _act
+
+    op_ = make_inplace(getattr(_act, fn_name))
+    op_.__name__ = fn_name + "_"
+    return op_
+
+
+elu_ = _inplace_act("elu")
+hardtanh_ = _inplace_act("hardtanh")
+leaky_relu_ = _inplace_act("leaky_relu")
+tanh_ = _inplace_act("tanh")
+thresholded_relu_ = _inplace_act("thresholded_relu")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    from paddle_tpu.ops.legacy_ps import class_center_sample as _ccs
+
+    return _ccs(label, num_classes, num_samples)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """FlashMask attention (reference F.flashmask_attention): sparse
+    row-interval masks for flash attention. The interval encoding
+    (startend_row_indices [B, H?, S, k]) is expanded to a dense mask and
+    fed to the standard attention path — same math; the flash-kernel
+    interval skipping is an optimization this backend leaves to XLA
+    fusion (documented divergence)."""
+    from paddle_tpu.nn.functional.flash_attention import (
+        scaled_dot_product_attention)
+
+    mask = None
+    if startend_row_indices is not None:
+        idx = (startend_row_indices._data
+               if isinstance(startend_row_indices, Tensor)
+               else jnp.asarray(startend_row_indices))
+        q = query._data if isinstance(query, Tensor) else query
+        S = q.shape[1]
+        # idx: [B, H, S, k] with k=1 (lower bound) or 2 (start, end)
+        qrow = jnp.arange(S)[None, None, :, None]
+        if idx.shape[-1] == 1:
+            # one column: start row per key col; masked iff q_row >= start
+            st = jnp.swapaxes(idx[..., 0][..., None], -1, -2)
+            masked = qrow >= st
+        else:
+            # (start, end) interval per key col; masked inside [start, end)
+            st = jnp.swapaxes(idx[..., 0][..., None], -1, -2)
+            en = jnp.swapaxes(idx[..., 1][..., None], -1, -2)
+            masked = (qrow >= st) & (qrow < en)
+        mask = Tensor(jnp.where(masked, -jnp.inf, 0.0).astype(q.dtype))
+    out = scaled_dot_product_attention(
+        query, key, value, attn_mask=mask, dropout_p=dropout,
+        is_causal=causal, training=training)
+    return out
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with a CSR connectivity pattern (reference
+    F.sparse_attention / `phi/kernels/gpu/sparse_attention_kernel`): each
+    query row attends only to its CSR columns. Dense-mask formulation —
+    the mask zeros the non-connected logits; same math as the kernel."""
+    # the CSR pattern is data, not a differentiable operand: concretize
+    # it here, BEFORE apply — inside the op fn the inputs are vjp tracers
+    # whenever q/k/v require grad, and tracers cannot be read on host
+    q0 = query._data if isinstance(query, Tensor) else jnp.asarray(query)
+    B, H, S, _ = q0.shape
+    off = sparse_csr_offset._data if isinstance(sparse_csr_offset, Tensor) \
+        else jnp.asarray(sparse_csr_offset)
+    cols = sparse_csr_columns._data \
+        if isinstance(sparse_csr_columns, Tensor) \
+        else jnp.asarray(sparse_csr_columns)
+    offh = np.asarray(jax.device_get(off)).astype(np.int64)
+    colh = np.asarray(jax.device_get(cols)).astype(np.int64)
+    m = np.full((B, H, S, S), False)
+    for b in range(B):
+        for h in range(H):
+            o = offh[b, h]
+            c = colh[b, h]
+            for r in range(S):
+                m[b, h, r, c[o[r]:o[r + 1]]] = True
+    allow = jnp.asarray(m)
+
+    def fn(q, k, v):
+        D = q.shape[-1]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(D)
+        logits = jnp.where(allow, logits, -jnp.inf)
+        w = jax.nn.softmax(logits, axis=-1)
+        w = jnp.where(jnp.isnan(w), 0.0, w)
+        return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+    return apply(fn, query, key, value, _name="sparse_attention")

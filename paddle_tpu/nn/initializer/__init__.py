@@ -182,3 +182,28 @@ def set_global_initializer(weight_init, bias_init=None):
 
 _global_weight_init = None
 _global_bias_init = None
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference
+    `nn/initializer/Bilinear`): weight[c_out, c_in, kh, kw] filled with
+    the separable triangle filter so a stride-s deconv starts as exact
+    bilinear interpolation."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError(
+                "Bilinear initializer expects a 4-D conv weight, got "
+                f"shape {list(shape)}")
+        kh, kw = shape[2], shape[3]
+
+        def tri(k):
+            f = (k + 1) // 2
+            center = f - 1 if k % 2 == 1 else f - 0.5
+            return 1 - np.abs(np.arange(k) - center) / f
+
+        kernel = np.outer(tri(kh), tri(kw)).astype("float32")
+        w = np.zeros(tuple(shape), "float32")
+        for i in range(shape[0]):
+            w[i, i % shape[1]] = kernel
+        return jnp.asarray(w, dtypes.convert_dtype(dtype))

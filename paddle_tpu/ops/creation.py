@@ -210,8 +210,9 @@ def poisson(x, name=None):
 
 
 def exponential_(x, lam=1.0, name=None):
-    x._data = jax.random.exponential(_rng.next_key(), tuple(x.shape), x.dtype) / lam
-    return x
+    return x._refill(
+        jax.random.exponential(_rng.next_key(), tuple(x.shape), x.dtype)
+        / lam)
 
 
 def standard_gamma(x, name=None):

@@ -70,9 +70,7 @@ def _dt(x):
 
 
 def is_floating_point(x):
-    return jnp.issubdtype(jnp.dtype(str(_dt(x)).replace("paddle.", "")),
-                          jnp.floating) if isinstance(_dt(x), str) \
-        else jnp.issubdtype(_dt(x), jnp.floating)
+    return jnp.issubdtype(_dt(x), jnp.floating)
 
 
 def is_integer(x):
@@ -262,7 +260,6 @@ def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
 
 def index_fill(x, index, axis, value, name=None):
     def fn(a, idx):
-        sl = [slice(None)] * a.ndim
         moved = jnp.moveaxis(a, axis, 0)
         moved = moved.at[idx].set(value)
         return jnp.moveaxis(moved, 0, axis)

@@ -246,7 +246,7 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
 
     dt = dtypes.convert_dtype(dtype)
     init = default_initializer or XavierNormal()
-    p = Parameter(init(tuple(shape)).astype(dt) if callable(init)
+    p = Parameter(jnp.asarray(init(tuple(shape), dt)) if callable(init)
                   else jnp.zeros(shape, dt))
     p.stop_gradient = False
     return p
