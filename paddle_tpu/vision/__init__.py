@@ -1,1 +1,37 @@
 from paddle_tpu.vision import datasets, models, ops, transforms  # noqa: F401
+
+# -- r5 final sweep: image backend selection (reference
+#    python/paddle/vision/image.py) ------------------------------------------
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend):
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend 'pil', 'cv2' or 'tensor', got {backend!r}")
+    if backend == "cv2":
+        raise ValueError(
+            "cv2 is not available in this image; use 'pil' or 'tensor'")
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """reference vision/image.py image_load: PIL image (or HWC tensor
+    with backend='tensor')."""
+    from PIL import Image
+
+    backend = backend or _image_backend
+    img = Image.open(path)
+    if backend == "tensor":
+        import numpy as np
+
+        import paddle_tpu as paddle
+
+        return paddle.to_tensor(np.asarray(img))
+    return img

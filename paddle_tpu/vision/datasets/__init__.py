@@ -134,3 +134,62 @@ def _load_image(path):
         return Image.open(path).convert("RGB")
     except Exception:
         return np.zeros((32, 32, 3), np.uint8)
+
+
+class Flowers(Dataset):
+    """reference vision/datasets/flowers.py (Oxford 102 flowers).
+    Synthetic stand-in (zero-egress image): class-coded color fields at
+    the real 3xHxW shape and 102-class label space."""
+
+    N_CLASSES = 102
+
+    def __init__(self, mode="train", transform=None, backend=None,
+                 image_size=64, n_items=128):
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = n_items if mode == "train" else max(16, n_items // 4)
+        self.labels = rng.integers(0, self.N_CLASSES, n).astype("int64")
+        hue = (self.labels[:, None, None, None] / self.N_CLASSES)
+        base = rng.random((n, 3, image_size, image_size)).astype("float32")
+        self.images = (0.5 * base + 0.5 * hue).astype("float32")
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img, lab = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lab
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """reference vision/datasets/voc2012.py: segmentation pairs
+    (image, mask). Synthetic stand-in: images with a class-coded
+    rectangle and the matching 21-class mask."""
+
+    N_CLASSES = 21
+
+    def __init__(self, mode="train", transform=None, backend=None,
+                 image_size=64, n_items=64):
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        n = n_items if mode == "train" else max(8, n_items // 4)
+        s = image_size
+        self.images = rng.random((n, 3, s, s)).astype("float32") * 0.3
+        self.masks = np.zeros((n, s, s), "int64")
+        for i in range(n):
+            cls = int(rng.integers(1, self.N_CLASSES))
+            x0, y0 = rng.integers(0, s // 2, 2)
+            h, w = rng.integers(s // 4, s // 2, 2)
+            self.images[i, :, y0:y0 + h, x0:x0 + w] += cls / self.N_CLASSES
+            self.masks[i, y0:y0 + h, x0:x0 + w] = cls
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img, mask = self.images[idx], self.masks[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.images)

@@ -18,3 +18,22 @@ from paddle_tpu.vision.models.shufflenetv2 import (  # noqa: F401
 from paddle_tpu.vision.models.vit import (  # noqa: F401
     VisionTransformer, vit_b_16, vit_tiny, vit_pipeline_descs,
 )
+from paddle_tpu.vision.models.resnet import (  # noqa: F401
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+    resnext152_32x4d, resnext152_64x4d, wide_resnet50_2, wide_resnet101_2,
+)
+from paddle_tpu.vision.models.densenet import densenet264  # noqa: F401
+from paddle_tpu.vision.models.shufflenetv2 import (  # noqa: F401
+    shufflenet_v2_x0_33, shufflenet_v2_swish,
+)
+from paddle_tpu.vision.models.mobilenetv1 import (  # noqa: F401
+    MobileNetV1, mobilenet_v1,
+)
+from paddle_tpu.vision.models.mobilenetv3 import (  # noqa: F401
+    MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large,
+    mobilenet_v3_small,
+)
+from paddle_tpu.vision.models.googlenet import GoogLeNet, googlenet  # noqa: F401
+from paddle_tpu.vision.models.inceptionv3 import (  # noqa: F401
+    InceptionV3, inception_v3,
+)

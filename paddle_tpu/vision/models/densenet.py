@@ -4,7 +4,7 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
-           "densenet201"]
+           "densenet201", "densenet264"]
 
 
 class _DenseLayer(nn.Layer):
@@ -38,7 +38,8 @@ class DenseNet(nn.Layer):
                  bn_size=4, num_classes=1000):
         super().__init__()
         cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
-                169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}
+                169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                264: (6, 12, 64, 48)}
         block_config = cfgs[layers]
         feats = [
             nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
@@ -82,3 +83,7 @@ def densenet169(pretrained=False, **kwargs):
 
 def densenet201(pretrained=False, **kwargs):
     return DenseNet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(264, **kwargs)

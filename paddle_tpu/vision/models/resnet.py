@@ -139,3 +139,30 @@ def resnet101(pretrained=False, **kwargs):
 
 def resnet152(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+
+
+# -- r5 final sweep: resnext + wide variants (reference resnet.py tail) ------
+# The shared ResNet chassis takes groups/width, so these are pure configs.
+
+def _resnext(depth, groups, width):
+    def f(pretrained=False, **kwargs):
+        return ResNet(BottleneckBlock, depth, width=width, groups=groups,
+                      **kwargs)
+
+    return f
+
+
+resnext50_32x4d = _resnext(50, 32, 4)
+resnext50_64x4d = _resnext(50, 64, 4)
+resnext101_32x4d = _resnext(101, 32, 4)
+resnext101_64x4d = _resnext(101, 64, 4)
+resnext152_32x4d = _resnext(152, 32, 4)
+resnext152_64x4d = _resnext(152, 64, 4)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=128, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=128, **kwargs)

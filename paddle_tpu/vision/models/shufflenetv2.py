@@ -3,8 +3,13 @@
 import paddle_tpu as paddle
 from paddle_tpu import nn
 
-__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
-           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def _act_layer(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
 
 
 def channel_shuffle(x, groups):
@@ -15,7 +20,7 @@ def channel_shuffle(x, groups):
 
 
 class InvertedResidual(nn.Layer):
-    def __init__(self, inp, oup, stride):
+    def __init__(self, inp, oup, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_features = oup // 2
@@ -25,7 +30,7 @@ class InvertedResidual(nn.Layer):
                           bias_attr=False),
                 nn.BatchNorm2D(inp),
                 nn.Conv2D(inp, branch_features, 1, bias_attr=False),
-                nn.BatchNorm2D(branch_features), nn.ReLU(),
+                nn.BatchNorm2D(branch_features), _act_layer(act),
             )
             b2_in = inp
         else:
@@ -33,12 +38,12 @@ class InvertedResidual(nn.Layer):
             b2_in = inp // 2
         self.branch2 = nn.Sequential(
             nn.Conv2D(b2_in, branch_features, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_features), nn.ReLU(),
+            nn.BatchNorm2D(branch_features), _act_layer(act),
             nn.Conv2D(branch_features, branch_features, 3, stride=stride,
                       padding=1, groups=branch_features, bias_attr=False),
             nn.BatchNorm2D(branch_features),
             nn.Conv2D(branch_features, branch_features, 1, bias_attr=False),
-            nn.BatchNorm2D(branch_features), nn.ReLU(),
+            nn.BatchNorm2D(branch_features), _act_layer(act),
         )
 
     def forward(self, x):
@@ -52,31 +57,36 @@ class InvertedResidual(nn.Layer):
 
 
 class ShuffleNetV2(nn.Layer):
-    def __init__(self, scale=1.0, num_classes=1000):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000):
         super().__init__()
+        if act not in ("relu", "swish"):
+            raise ValueError(f"act must be relu or swish, got {act!r}")
+        self.act = act
         stage_repeats = [4, 8, 4]
-        channels = {0.25: [24, 24, 48, 96, 512], 0.5: [24, 48, 96, 192, 1024],
+        channels = {0.25: [24, 24, 48, 96, 512],
+                    0.33: [24, 32, 64, 128, 512],
+                    0.5: [24, 48, 96, 192, 1024],
                     1.0: [24, 116, 232, 464, 1024],
                     1.5: [24, 176, 352, 704, 1024],
                     2.0: [24, 244, 488, 976, 2048]}[scale]
         self.conv1 = nn.Sequential(
             nn.Conv2D(3, channels[0], 3, stride=2, padding=1,
                       bias_attr=False),
-            nn.BatchNorm2D(channels[0]), nn.ReLU(),
+            nn.BatchNorm2D(channels[0]), _act_layer(act),
         )
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         inp = channels[0]
         for repeats, oup in zip(stage_repeats, channels[1:4]):
-            blocks = [InvertedResidual(inp, oup, 2)]
-            blocks += [InvertedResidual(oup, oup, 1)
+            blocks = [InvertedResidual(inp, oup, 2, act)]
+            blocks += [InvertedResidual(oup, oup, 1, act)
                        for _ in range(repeats - 1)]
             stages.append(nn.Sequential(*blocks))
             inp = oup
         self.stages = nn.Sequential(*stages)
         self.conv5 = nn.Sequential(
             nn.Conv2D(inp, channels[-1], 1, bias_attr=False),
-            nn.BatchNorm2D(channels[-1]), nn.ReLU(),
+            nn.BatchNorm2D(channels[-1]), _act_layer(act),
         )
         self.avgpool = nn.AdaptiveAvgPool2D(1)
         self.fc = nn.Linear(channels[-1], num_classes)
@@ -101,3 +111,8 @@ shufflenet_v2_x0_5 = _make(0.5)
 shufflenet_v2_x1_0 = _make(1.0)
 shufflenet_v2_x1_5 = _make(1.5)
 shufflenet_v2_x2_0 = _make(2.0)
+shufflenet_v2_x0_33 = _make(0.33)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, act="swish", **kwargs)

@@ -1,5 +1,6 @@
 """Vision transforms over numpy arrays (reference: `python/paddle/vision/transforms/`)."""
 
+import math
 import numbers
 
 import numpy as np
@@ -149,3 +150,282 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+# -- r5 final sweep: the rest of the reference transforms surface
+#    (reference python/paddle/vision/transforms/transforms.py) --------------
+
+from paddle_tpu.vision.transforms import functional as F  # noqa: E402
+from paddle_tpu.vision.transforms.functional import (  # noqa: E402,F401
+    adjust_brightness, adjust_contrast, adjust_hue, affine, center_crop,
+    crop, erase, hflip, pad, perspective, rotate, to_grayscale, vflip,
+)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        # float v means jitter in [max(0, 1-v), 1+v]; an explicit
+        # (lo, hi) tuple is passed through (reference _check_input)
+        if isinstance(value, (list, tuple)):
+            lo, hi = value
+        else:
+            if value < 0:
+                raise ValueError("brightness value should be non-negative")
+            lo, hi = max(0.0, 1 - value), 1 + value
+        if lo > hi or lo < 0:
+            raise ValueError(f"invalid brightness range {(lo, hi)}")
+        self.value = (float(lo), float(hi))
+
+    def _apply_image(self, img):
+        lo, hi = self.value
+        if lo == hi == 1.0:
+            return img
+        return F.adjust_brightness(img, np.random.uniform(lo, hi))
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        # float v means jitter in [max(0, 1-v), 1+v]; an explicit
+        # (lo, hi) tuple is passed through (reference _check_input)
+        if isinstance(value, (list, tuple)):
+            lo, hi = value
+        else:
+            if value < 0:
+                raise ValueError("contrast value should be non-negative")
+            lo, hi = max(0.0, 1 - value), 1 + value
+        if lo > hi or lo < 0:
+            raise ValueError(f"invalid contrast range {(lo, hi)}")
+        self.value = (float(lo), float(hi))
+
+    def _apply_image(self, img):
+        lo, hi = self.value
+        if lo == hi == 1.0:
+            return img
+        return F.adjust_contrast(img, np.random.uniform(lo, hi))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        # float v means jitter in [max(0, 1-v), 1+v]; an explicit
+        # (lo, hi) tuple is passed through (reference _check_input)
+        if isinstance(value, (list, tuple)):
+            lo, hi = value
+        else:
+            if value < 0:
+                raise ValueError("saturation value should be non-negative")
+            lo, hi = max(0.0, 1 - value), 1 + value
+        if lo > hi or lo < 0:
+            raise ValueError(f"invalid saturation range {(lo, hi)}")
+        self.value = (float(lo), float(hi))
+
+    def _apply_image(self, img):
+        lo, hi = self.value
+        if lo == hi == 1.0:
+            return img
+        return F.adjust_saturation(img, np.random.uniform(lo, hi))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Apply brightness/contrast/saturation/hue jitter in random order
+    (reference transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for idx in order:
+            img = self.transforms[idx]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return F.to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill, self.padding_mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return F.pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            degrees = (-degrees, degrees)
+        self.degrees = tuple(degrees)
+        self.expand, self.center, self.fill = expand, center, fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return F.rotate(img, angle, expand=self.expand, center=self.center,
+                        fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = tuple(degrees)
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+        self.fill, self.center = fill, center
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = (arr.shape[:2] if arr.ndim == 2 or arr.shape[-1] in (1, 3, 4)
+                else arr.shape[1:3])
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        scale = (np.random.uniform(*self.scale_rng)
+                 if self.scale_rng is not None else 1.0)
+        shear = (0.0, 0.0)
+        if self.shear is not None:
+            sh = self.shear
+            if isinstance(sh, numbers.Number):
+                sh = (-sh, sh)
+            shear = (np.random.uniform(sh[0], sh[1]),
+                     np.random.uniform(sh[2], sh[3]) if len(sh) == 4 else 0.0)
+        return F.affine(img, angle, (tx, ty), scale, shear, fill=self.fill,
+                        center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.distortion_scale, self.fill = (
+            prob, distortion_scale, fill)
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        h, w = arr.shape[:2] if (arr.ndim == 2 or arr.shape[-1] in (1, 3, 4)) \
+            else arr.shape[1:3]
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return F.perspective(img, start, end, fill=self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Crop a random area/aspect patch and resize it (reference
+    transforms.RandomResizedCrop — the ImageNet training crop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, numbers.Number) \
+            else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) \
+            and arr.shape[-1] not in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = math.exp(np.random.uniform(math.log(self.ratio[0]),
+                                            math.log(self.ratio[1])))
+            cw = int(round(math.sqrt(target * ar)))
+            ch = int(round(math.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                break
+        else:
+            cw, ch = min(w, h), min(w, h)
+            i, j = (h - ch) // 2, (w - cw) // 2
+        patch = arr[:, i:i + ch, j:j + cw] if chw \
+            else arr[i:i + ch, j:j + cw]
+        return Resize(self.size, self.interpolation)(patch)
+
+
+class RandomErasing(BaseTransform):
+    """Randomly blank a rectangle (reference transforms.RandomErasing;
+    Zhong et al. 2017)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img if not hasattr(img, "numpy") else img.numpy())
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4) \
+            and arr.shape[-1] not in (1, 3, 4)
+        h, w = (arr.shape[1], arr.shape[2]) if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(math.sqrt(target / ar)))
+            ew = int(round(math.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    vshape = ((arr.shape[0], eh, ew) if chw
+                              else (eh, ew) + ((arr.shape[2],)
+                                               if arr.ndim == 3 else ()))
+                    v = np.random.standard_normal(vshape).astype(np.float32)
+                else:
+                    v = self.value
+                if chw:
+                    out = arr.copy()
+                    out[:, i:i + eh, j:j + ew] = v
+                    return out
+                return F.erase(img, i, j, eh, ew, v, inplace=self.inplace)
+        return img
+
