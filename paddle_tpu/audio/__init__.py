@@ -13,4 +13,6 @@ from paddle_tpu.audio import functional  # noqa: F401
 from paddle_tpu.audio import features  # noqa: F401
 from paddle_tpu.audio.backends import load, save, info  # noqa: F401
 
-__all__ = ["functional", "features", "backends", "load", "save", "info"]
+from paddle_tpu.audio import datasets  # noqa: F401,E402
+
+__all__ = ["functional", "features", "backends", "datasets", "load", "save", "info"]

@@ -1,0 +1,86 @@
+"""paddle.audio.datasets (reference `python/paddle/audio/datasets/
+{esc50,tess}.py`): ESC-50 and TESS. Zero-egress image — the real archives
+cannot be downloaded, so these are deterministic synthetic stand-ins with
+the reference's exact shapes/label spaces (same pattern as
+paddle_tpu.text datasets), suitable for pipeline and feature tests."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.io import Dataset
+
+__all__ = ["ESC50", "TESS"]
+
+
+class _SyntheticAudioDataset(Dataset):
+    N_CLASSES = 2
+    SAMPLE_RATE = 16000
+    DURATION = 1.0  # seconds per clip (reference clips are longer; kept
+    # short so feature extraction in tests stays fast)
+
+    def __init__(self, mode="train", feat_type="raw", seed=0, n_items=64,
+                 **feat_kwargs):
+        self.mode = mode
+        self.feat_type = feat_type
+        self.feat_kwargs = feat_kwargs
+        rng = np.random.default_rng(seed + (0 if mode == "train" else 1))
+        n = n_items if mode == "train" else max(8, n_items // 4)
+        t = int(self.SAMPLE_RATE * self.DURATION)
+        self.labels = rng.integers(0, self.N_CLASSES, n).astype("int64")
+        # label-dependent tone + noise so classifiers can actually learn
+        base = np.linspace(0, self.DURATION, t, dtype="float32")
+        self.waves = np.stack([
+            np.sin(2 * np.pi * (200 + 50 * int(lb)) * base)
+            + 0.1 * rng.standard_normal(t).astype("float32")
+            for lb in self.labels
+        ]).astype("float32")
+
+    def _feature(self, wav):
+        if self.feat_type == "raw":
+            return wav
+        from paddle_tpu.audio import features
+        import paddle_tpu as paddle
+
+        x = paddle.to_tensor(wav[None, :])
+        if self.feat_type == "mfcc":
+            return features.MFCC(sr=self.SAMPLE_RATE,
+                                 **self.feat_kwargs)(x).numpy()[0]
+        if self.feat_type == "logmelspectrogram":
+            return features.LogMelSpectrogram(
+                sr=self.SAMPLE_RATE, **self.feat_kwargs)(x).numpy()[0]
+        if self.feat_type == "melspectrogram":
+            return features.MelSpectrogram(
+                sr=self.SAMPLE_RATE, **self.feat_kwargs)(x).numpy()[0]
+        if self.feat_type == "spectrogram":
+            return features.Spectrogram(**self.feat_kwargs)(x).numpy()[0]
+        raise ValueError(f"unknown feat_type {self.feat_type!r}")
+
+    def __getitem__(self, idx):
+        return self._feature(self.waves[idx]), self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class ESC50(_SyntheticAudioDataset):
+    """reference audio/datasets/esc50.py: 50 environmental sound classes."""
+
+    N_CLASSES = 50
+    SAMPLE_RATE = 44100
+    DURATION = 0.25
+
+    def __init__(self, mode="train", split=1, feat_type="raw", **kw):
+        super().__init__(mode=mode, feat_type=feat_type, seed=split, **kw)
+
+
+class TESS(_SyntheticAudioDataset):
+    """reference audio/datasets/tess.py: 7 emotion classes."""
+
+    N_CLASSES = 7
+    SAMPLE_RATE = 24414
+    DURATION = 0.25
+
+    def __init__(self, mode="train", n_folds=1, split=1, feat_type="raw",
+                 **kw):
+        super().__init__(mode=mode, feat_type=feat_type, seed=split, **kw)

@@ -22,3 +22,76 @@ __all__ = [
     "max_memory_allocated", "max_memory_reserved", "memory_allocated",
     "memory_reserved", "set_device", "synchronize",
 ]
+
+
+# -- r5 final sweep: remaining reference device surface ----------------------
+
+
+class IPUPlace:
+    """No IPU on this backend (reference device/__init__.py IPUPlace);
+    constructing one is a loud error, mirroring a non-IPU build."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError("paddle_tpu is not compiled with IPU support")
+
+
+class XPUPlace:
+    """XPU requests route to the TPU (the best device), like CUDAPlace."""
+
+    def __init__(self, dev_id=0):
+        self.dev_id = dev_id
+
+    def __repr__(self):
+        return f"Place(xpu->tpu:{self.dev_id})"
+
+
+def get_all_device_type():
+    import jax
+
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return [d for d in get_available_device() if not d.startswith("cpu")]
+
+
+def get_cudnn_version():
+    return None  # reference returns None when not compiled with CUDA
+
+
+def is_compiled_with_cinn():
+    return False  # XLA is the compiler here, not CINN
+
+
+def is_compiled_with_distribute():
+    return True  # jax.distributed / TCPStore collectives are always in
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def set_stream(stream=None):
+    """Streams are implicit in XLA's async dispatch; accepted, returns
+    the previous (singleton) stream like the reference."""
+    return current_stream()
+
+
+def stream_guard(stream=None):
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+__all__ += [
+    "IPUPlace", "XPUPlace", "get_all_device_type", "get_available_device",
+    "get_available_custom_device", "get_cudnn_version",
+    "is_compiled_with_cinn", "is_compiled_with_distribute",
+    "is_compiled_with_ipu", "set_stream", "stream_guard",
+]

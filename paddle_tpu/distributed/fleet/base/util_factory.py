@@ -1,0 +1,78 @@
+"""fleet.UtilBase (reference `python/paddle/distributed/fleet/base/
+util_factory.py`): small cross-worker utilities. The reference runs these
+over Gloo; here they ride the TCPStore collective backend when
+distributed is initialized, and degrade to single-process identities
+otherwise (same contract as the reference under world_size==1)."""
+
+from __future__ import annotations
+
+__all__ = ["UtilBase"]
+
+
+class UtilBase:
+    def __init__(self):
+        self.role_maker = None
+
+    def _set_role_maker(self, role_maker):
+        self.role_maker = role_maker
+
+    @staticmethod
+    def _world():
+        import paddle_tpu.distributed as dist
+
+        try:
+            return dist.get_world_size()
+        except Exception:
+            return 1
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        if self._world() <= 1:
+            return np.asarray(input)
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        t = paddle.to_tensor(np.asarray(input))
+        op = {"sum": dist.ReduceOp.SUM, "max": dist.ReduceOp.MAX,
+              "min": dist.ReduceOp.MIN}[mode]
+        dist.all_reduce(t, op=op)
+        return t.numpy()
+
+    def all_gather(self, input, comm_world="worker"):
+        if self._world() <= 1:
+            return [input]
+        import numpy as np
+
+        import paddle_tpu as paddle
+        import paddle_tpu.distributed as dist
+
+        out = []
+        dist.all_gather(out, paddle.to_tensor(np.asarray(input)))
+        return [o.numpy() for o in out]
+
+    def barrier(self, comm_world="worker"):
+        if self._world() <= 1:
+            return
+        import paddle_tpu.distributed as dist
+
+        dist.barrier()
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (reference
+        UtilBase.get_file_shard): worker i takes files[i::n] style
+        contiguous blocks, remainder to the first workers."""
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file names")
+        rm = self.role_maker
+        n = rm.worker_num() if rm is not None else 1
+        idx = rm.worker_index() if rm is not None else 0
+        per, rem = divmod(len(files), n)
+        start = idx * per + min(idx, rem)
+        return files[start:start + per + (1 if idx < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        rm = self.role_maker
+        me = rm.worker_index() if rm is not None else 0
+        if me == rank_id:
+            print(message)

@@ -1,0 +1,97 @@
+"""PS data generators (reference `python/paddle/distributed/fleet/
+data_generator/data_generator.py`): user subclasses implement
+generate_sample; these classes frame each sample into the MultiSlot text
+protocol the reference's Dataset/DataFeed readers consume
+(`slot_num value... slot_num value...`)."""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["MultiSlotDataGenerator", "MultiSlotStringDataGenerator"]
+
+
+class _DataGeneratorBase:
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """User hook: return a generator yielding
+        [(slot_name, [values...]), ...] per sample."""
+        raise NotImplementedError(
+            "subclasses must implement generate_sample(line)")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _format(self, sample):
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            g = self.generate_sample(line)
+            if g is None:
+                continue
+            for sample in g():
+                sys.stdout.write(self._format(sample))
+
+    def run_from_memory(self, lines=None):
+        """Return framed strings instead of writing stdout (test/loader
+        path)."""
+        out = []
+        for line in (lines if lines is not None else [None]):
+            g = self.generate_sample(line)
+            if g is None:
+                continue
+            for sample in g():
+                out.append(self._format(sample))
+        return out
+
+
+class MultiSlotDataGenerator(_DataGeneratorBase):
+    """Values are numbers; each slot framed as `<n> v1 ... vn`."""
+
+    def _format(self, sample):
+        if not isinstance(sample, (list, tuple)) or not sample:
+            raise ValueError(
+                "generate_sample must yield a non-empty list of "
+                "(slot_name, values) pairs")
+        parts = []
+        names = []
+        for name, values in sample:
+            if not isinstance(values, (list, tuple)) or not values:
+                raise ValueError(f"slot {name!r} has no values")
+            names.append(str(name))
+            parts.append(str(len(values)) + " "
+                         + " ".join(str(v) for v in values))
+        if self._proto_info is None:
+            self._proto_info = names
+        elif names != self._proto_info:
+            raise ValueError(
+                f"slot order changed between samples: {self._proto_info} "
+                f"-> {names}")
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(_DataGeneratorBase):
+    """Values are raw strings; no numeric validation (reference
+    MultiSlotStringDataGenerator — the fast path)."""
+
+    def _format(self, sample):
+        if not isinstance(sample, (list, tuple)) or not sample:
+            raise ValueError(
+                "generate_sample must yield a non-empty list of "
+                "(slot_name, values) pairs")
+        parts = []
+        for _, values in sample:
+            parts.append(str(len(values)) + " "
+                         + " ".join(str(v) for v in values))
+        return " ".join(parts) + "\n"

@@ -39,3 +39,173 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
         return jax.nn.softmax(z, axis=-1).astype(a.dtype)
 
     return apply(fn, x, _name="fused_softmax_mask_upper_triangle")
+
+
+# -- r5 final sweep: the rest of the reference incubate surface --------------
+
+from paddle_tpu.geometric import (  # noqa: E402,F401
+    segment_max, segment_mean, segment_min, segment_sum,
+)
+from paddle_tpu.nn.functional.loss import identity_loss  # noqa: E402,F401
+from paddle_tpu import inference  # noqa: E402,F401
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """reference incubate graph_send_recv — the pre-geometric spelling of
+    geometric.send_u_recv."""
+    from paddle_tpu.geometric import send_u_recv
+
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    from paddle_tpu.geometric import reindex_graph
+
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from paddle_tpu.geometric import sample_neighbors
+
+    return sample_neighbors(row, colptr, input_nodes, sample_size=sample_size,
+                            eids=eids, return_eids=return_eids,
+                            perm_buffer=perm_buffer)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    from paddle_tpu.geometric import khop_sampler
+
+    return khop_sampler(row, colptr, input_nodes, sample_sizes,
+                        sorted_eids=sorted_eids, return_eids=return_eids)
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference
+    `incubate/optimizer/lookahead.py`; Zhang et al. 2019): every k inner
+    steps, slow weights move alpha toward the fast weights and the fast
+    weights reset to the slow ones."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner_optimizer must not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step = 0
+        self._slow = None
+        self._parameter_list = inner_optimizer._parameter_list
+
+    def _params(self):
+        return self.inner_optimizer._parameter_list or []
+
+    def step(self):
+        import jax.numpy as jnp
+
+        if self._slow is None:
+            self._slow = [jnp.asarray(p._data) for p in self._params()]
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._step % self.k == 0:
+            for i, p in enumerate(self._params()):
+                slow = self._slow[i] + self.alpha * (p._data - self._slow[i])
+                self._slow[i] = slow
+                p._data = slow
+
+    def clear_grad(self, set_to_zero=True):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead_step"] = self._step
+        if self._slow is not None:
+            for i, s in enumerate(self._slow):
+                sd[f"@lookahead_slow_{i}"] = s
+        return sd
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Exponential/windowed parameter averaging for eval (reference
+    `incubate/optimizer/modelaverage.py`): accumulates running parameter
+    sums during training; apply() swaps averaged weights in,
+    restore() swaps training weights back."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._parameter_list = list(parameters) if parameters else []
+        self._sum = None
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        import jax.numpy as jnp
+
+        ps = self._parameter_list
+        if self._sum is None:
+            self._sum = [jnp.zeros_like(p._data) for p in ps]
+        window = max(int(self.min_average_window), 1)
+        window = max(window, min(int(self.max_average_window),
+                                 int(self._count * self.average_window)
+                                 or window))
+        if self._count >= window > 1:
+            # roll: decay old mass so the sum tracks ~window recent steps
+            # without storing them individually
+            keep = (window - 1) / window
+            self._sum = [s * keep for s in self._sum]
+            self._count = self._count * keep
+        for i, p in enumerate(ps):
+            self._sum[i] = self._sum[i] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        if self._sum is None or self._count <= 0:
+            return contextlib.nullcontext()
+        self._backup = [p._data for p in self._parameter_list]
+        for p, s in zip(self._parameter_list, self._sum):
+            p._data = s / self._count
+
+        ma = self
+
+        @contextlib.contextmanager
+        def ctx():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    ma.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._parameter_list, self._backup):
+                p._data = b
+            self._backup = None
+
+    def minimize(self, loss, startup_program=None):
+        self.step()

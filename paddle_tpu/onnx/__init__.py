@@ -1,0 +1,20 @@
+"""paddle.onnx (reference `python/paddle/onnx/__init__.py`: export via
+paddle2onnx). This image has neither the onnx package nor network access,
+so export is a LOUD gate, not a silent no-op — the StableHLO export
+(`paddle_tpu.jit.save`) is the supported serialization on this backend."""
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "paddle.onnx.export requires the `onnx`/`paddle2onnx` packages, "
+            "which are not in this hermetic image. Use paddle_tpu.jit.save "
+            "(StableHLO + weights) for deployment; paddle_tpu.inference "
+            "loads it directly.")
+    raise NotImplementedError(
+        "ONNX emission from the jax program is not implemented; use "
+        "paddle_tpu.jit.save -> paddle_tpu.inference instead.")

@@ -1,0 +1,84 @@
+"""paddle.utils (reference `python/paddle/utils/__init__.py`):
+deprecation decorator, version gate, install self-check, soft import."""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+__all__ = ["deprecated", "require_version", "run_check", "try_import"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference utils/deprecated.py: warn (level<=1) or raise (level==2)
+    on use of a deprecated API."""
+
+    def decorator(fn):
+        msg = f"API \"{fn.__module__}.{fn.__name__}\" is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use \"{update_to}\" instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level < 2:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def require_version(min_version, max_version=None):
+    """reference utils/layers_utils.py require_version: raise unless the
+    installed version is within [min_version, max_version]."""
+    import paddle_tpu
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = parse(paddle_tpu.__version__)
+    if min_version and cur < parse(min_version):
+        raise RuntimeError(
+            f"paddle version {paddle_tpu.__version__} < required "
+            f"{min_version}")
+    if max_version and cur > parse(max_version):
+        raise RuntimeError(
+            f"paddle version {paddle_tpu.__version__} > allowed "
+            f"{max_version}")
+    return True
+
+
+def run_check():
+    """reference utils/install_check.py run_check: a tiny end-to-end
+    train step on the current device, printing the verdict."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    dev = paddle.get_device()
+    m = nn.Linear(4, 2)
+    x = paddle.randn([8, 4])
+    loss = (m(x) ** 2).mean()
+    loss.backward()
+    assert m.weight.grad is not None
+    print(f"PaddlePaddle (tpu-native) works fine on {dev}.")
+    print("PaddlePaddle (tpu-native) is installed successfully!")
+
+
+def try_import(module_name, err_msg=None):
+    """reference utils/lazy_import.py try_import: import or raise with an
+    install hint."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"Failed to import {module_name}. This environment "
+            "is hermetic (no pip install); the dependency must be baked "
+            "into the image.") from e
