@@ -106,3 +106,9 @@ from paddle_tpu.core.ops_patch import \
 
 _iiv()
 del _iiv
+
+from paddle_tpu.core.tensor_methods import \
+    install_tensor_methods as _itm  # noqa: E402
+
+_itm()
+del _itm

@@ -1366,3 +1366,81 @@ class TestFinalSweepSurfaces:
         rx = M.resnext50_32x4d(num_classes=4, with_pool=True)
         assert rx(x).shape == [1, 4]
 
+
+
+def test_tensor_method_surface_parity():
+    """Every reference tensor_method_func name (the x.op() surface,
+    `python/paddle/tensor/__init__.py`) is a Tensor method here, and the
+    handful without top-level spellings behave."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.core.tensor_methods import (
+        _METHOD_NAMES, reference_method_names)
+
+    names = reference_method_names()
+    assert len(names) > 350
+    # the baked import-time list still matches the reference
+    assert sorted(set(names)) == sorted(set(_METHOD_NAMES))
+    missing = sorted(n for n in names if not hasattr(Tensor, n))
+    assert not missing, f"Tensor missing methods {missing}"
+    # methods dispatch through the same fns: x.op() == paddle.op(x)
+    x = paddle.to_tensor(np.random.default_rng(0).random((4, 3))
+                         .astype("float32"))
+    np.testing.assert_allclose(x.nanmean().numpy(),
+                               paddle.nanmean(x).numpy())
+    assert x.rot90().shape == [3, 4]
+    assert x.mv(paddle.ones([3])).shape == [4]
+    # cholesky_inverse == inv(A) given A's factor
+    A = np.random.default_rng(1).random((3, 3)).astype("float32")
+    A = A @ A.T + 3 * np.eye(3, dtype="float32")
+    L = np.linalg.cholesky(A)
+    got = paddle.cholesky_inverse(paddle.to_tensor(L)).numpy()
+    np.testing.assert_allclose(got, np.linalg.inv(A), atol=1e-4)
+    # svd_lowrank reconstructs a rank-2 matrix
+    u = np.random.default_rng(2).random((8, 2)).astype("float32")
+    m = u @ u.T
+    U, S, V = paddle.svd_lowrank(paddle.to_tensor(m), q=4)
+    rec = (U.numpy() * S.numpy()) @ V.numpy().T
+    np.testing.assert_allclose(rec, m, atol=1e-4)
+    # resize_ / set_ rebind storage and sever history
+    t = paddle.to_tensor(np.arange(6, dtype="float32"))
+    t.resize_([2, 2])
+    assert t.numpy().tolist() == [[0.0, 1.0], [2.0, 3.0]]
+    t.set_(paddle.ones([5]))
+    assert t.shape == [5] and t._node is None
+    # in-place trig through the shared builder
+    a = paddle.to_tensor(np.array([1.5], "float32"))
+    b = a * 1.0
+    b.acosh_()
+    np.testing.assert_allclose(b.numpy(), np.arccosh([1.5]), rtol=1e-6)
+    # ormqr applies Q implicitly — correct for NON-SQUARE x in all four
+    # orientations (checked against the explicitly built full Q)
+    import scipy.linalg as sla
+
+    Araw = np.random.default_rng(3).random((5, 3)).astype("float64")
+    (h, tau), _ = sla.qr(Araw, mode="raw")
+    Q = np.eye(5)
+    for i in range(3):
+        v = np.zeros(5)
+        v[i] = 1
+        v[i + 1:] = h[i + 1:, i]
+        Q = Q @ (np.eye(5) - tau[i] * np.outer(v, v))
+    args = (paddle.to_tensor(h.astype("float32")),
+            paddle.to_tensor(tau.astype("float32")))
+    y = np.random.default_rng(4).random((5, 2)).astype("float32")
+    yr = np.random.default_rng(5).random((2, 5)).astype("float32")
+    np.testing.assert_allclose(
+        paddle.ormqr(*args, paddle.to_tensor(y)).numpy(), Q @ y, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.ormqr(*args, paddle.to_tensor(y), transpose=True).numpy(),
+        Q.T @ y, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.ormqr(*args, paddle.to_tensor(yr), left=False).numpy(),
+        yr @ Q, atol=1e-5)
+    np.testing.assert_allclose(
+        paddle.ormqr(*args, paddle.to_tensor(yr), left=False,
+                     transpose=True).numpy(), yr @ Q.T, atol=1e-5)
+    # 0-size resize_ growth zero-fills instead of dividing by zero
+    z = paddle.ones([3])
+    z.set_()
+    z.resize_([2, 2])
+    assert z.numpy().tolist() == [[0.0, 0.0], [0.0, 0.0]]
