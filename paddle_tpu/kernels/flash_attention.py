@@ -351,8 +351,16 @@ def _flash_attention(q, k, v, causal, sm_scale, interpret):
 
 
 def _fa_fwd(q, k, v, causal, sm_scale, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
     qt, kt, vt = _to_bhsd(q), _to_bhsd(k), _to_bhsd(v)
     o, lse = _flash_fwd(qt, kt, vt, causal, sm_scale, interpret=interpret)
+    # name the residuals the bwd kernels need, so a remat policy that saves
+    # "attn"/"attn_lse" (models.llama_functional remat='lean') skips the
+    # flash-forward recompute entirely — without the lse name, saving just
+    # the layer output still re-runs the kernel to rebuild lse
+    o = checkpoint_name(o, "attn")
+    lse = checkpoint_name(lse, "attn_lse")
     return _to_bhsd(o), (qt, kt, vt, o, lse)
 
 

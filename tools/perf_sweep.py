@@ -27,6 +27,14 @@ H2048 = dict(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
 # left no room); 'dots' + chunked CE + 2 accumulated micro-batches won at
 # ~17.5k. r5: moments='bf16' (stochastic-rounded) frees 3.8GB and
 # 'factored' ~7.3GB — sweep 'half' and no-remat at the freed budget.
+#
+# r5 RESULT (2026-08-01, driver-verifiable in BENCH_r05.json): the decisive
+# lever was none of the above — xprof showed ~17% of the step in the layer
+# scan's dynamic-update-slice residual stacking. With the layer loop
+# UNROLLED (engine `unroll`, default on a 1x1x1 mesh) no-remat fits at M=2
+# even with f32 moments: b8 21.4k tok/s / 0.64 MFU, b32 23.1k / 0.69 MFU
+# (sweep history: dots+M2 17.7k -> unroll 19.1k -> lean 19.3k ->
+# no-remat 21.0k). tools/perf_sweep2.py holds the follow-up grid.
 SPECS = [
     # r4 champion re-run (comparison point)
     {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "dots",

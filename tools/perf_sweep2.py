@@ -1,0 +1,77 @@
+"""Targeted round-5 follow-up sweep: kill the remat recompute entirely.
+
+Sweep-1 evidence (tools/perf_sweep.py log, TPU v5e 2026-08-01):
+  dots+M2+f32            17678.6  <- r4 champion, reproduced on hardware
+  dots+M2+bf16           17301.2  <- stochastic-rounding RNG costs more
+                                     than the moment-HBM it saves
+  b4 no-remat bf16 M1    17251.6  <- no-remat FITS at 4-row micro-batches
+  half (any)             OOM / slow
+
+Hypothesis: micro_batches=2 gives per-microbatch activations of the b4
+run while keeping the b8 global batch and a single optimizer update —
+no-remat + M2 should beat dots + M2 by the dots policy's backward
+recompute (attention fwd + elementwise re-passes, ~3-5% of the step).
+
+Run:  python tools/perf_sweep2.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+H2048 = dict(vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+             num_hidden_layers=16, num_attention_heads=16,
+             max_position_embeddings=2048)
+
+SPECS = [
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": False,
+     "loss_chunk": 128, "micro_batches": 2, "moments": "bf16"},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": False,
+     "loss_chunk": 128, "micro_batches": 2},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": False,
+     "loss_chunk": 128, "micro_batches": 4, "moments": "bf16"},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "half",
+     "loss_chunk": 128, "micro_batches": 2},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": "dots",
+     "loss_chunk": 256, "micro_batches": 2},
+    {"cfg": H2048, "batch": 8, "seq": 1024, "remat": False,
+     "loss_chunk": 256, "micro_batches": 2, "moments": "bf16"},
+]
+
+
+def main():
+    results = []
+    for spec in SPECS:
+        label = {k: v for k, v in spec.items() if k != "cfg"}
+        try:
+            out = subprocess.run(
+                [sys.executable, BENCH, "--single", json.dumps(spec)],
+                capture_output=True, text=True, timeout=900, cwd=REPO)
+            got = None
+            for line in out.stdout.splitlines():
+                if line.startswith("BENCH_RESULT "):
+                    got = json.loads(line[len("BENCH_RESULT "):])
+            if got:
+                got["spec"] = spec
+                results.append(got)
+                print(f"{label} -> {got['tps']:.1f} tok/s", flush=True)
+            else:
+                tail = out.stderr[-400:].replace("\n", " ")
+                print(f"{label} -> FAILED: {tail}", flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"{label} -> TIMEOUT", flush=True)
+    if results:
+        best = max(results, key=lambda r: r["tps"])
+        print("BEST " + json.dumps(
+            {"tps": best["tps"],
+             "spec": {k: v for k, v in best["spec"].items() if k != "cfg"}}))
+
+
+if __name__ == "__main__":
+    main()
