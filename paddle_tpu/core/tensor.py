@@ -195,12 +195,18 @@ class Tensor:
 
     # -- conversions --------------------------------------------------------
     def numpy(self):
+        if _mutation_hook is not None:
+            _mutation_hook(self, "numpy() materialization")
         return np.asarray(self._data)
 
     def item(self, *args):
         if args:
-            return self.numpy().item(*args)
-        return self.numpy().item()
+            v = np.asarray(self._data).item(*args)
+        else:
+            v = np.asarray(self._data).item()
+        if _concrete_hook is not None:
+            _concrete_hook(self, "item", v)
+        return v
 
     def tolist(self):
         return self.numpy().tolist()
@@ -221,6 +227,13 @@ class Tensor:
         return apply(lambda x: x + jnp.zeros((), x.dtype), self, _name="clone")
 
     def detach(self):
+        if _op_capture is not None:
+            # under SOT capture the detach boundary must live ON the tape,
+            # or the compiled segment's vjp would flow grads through it
+            t = apply(jax.lax.stop_gradient, self, _name="detach")
+            t.stop_gradient = True
+            t._node = None
+            return t
         t = Tensor(self._data, stop_gradient=True)
         return t
 
@@ -269,6 +282,8 @@ class Tensor:
     def _refill(self, data):
         # a fill erases this tensor's history: keeping the old grad node
         # would send backward through the pre-fill op with the new data
+        if _mutation_hook is not None:
+            _mutation_hook(self, "in-place refill")
         self._data = data
         self._node = None
         self._out_idx = 0
@@ -309,33 +324,47 @@ class Tensor:
 
     # -- in-place helpers (optimizer path, runs under no_grad) -------------
     def copy_(self, other, *args):
+        if _mutation_hook is not None:
+            _mutation_hook(self, "copy_")
         self._data = other._data if isinstance(other, Tensor) else jnp.asarray(other)
         return self
 
     def set_value(self, value):
+        if _mutation_hook is not None:
+            _mutation_hook(self, "set_value")
         self._data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
         return self
 
     def add_(self, y):
+        if _mutation_hook is not None:
+            _mutation_hook(self, "add_")
         data = y._data if isinstance(y, Tensor) else y
         self._data = self._data + data
         return self
 
     def subtract_(self, y):
+        if _mutation_hook is not None:
+            _mutation_hook(self, "subtract_")
         data = y._data if isinstance(y, Tensor) else y
         self._data = self._data - data
         return self
 
     def multiply_(self, y):
+        if _mutation_hook is not None:
+            _mutation_hook(self, "multiply_")
         data = y._data if isinstance(y, Tensor) else y
         self._data = self._data * data
         return self
 
     def scale_(self, scale=1.0, bias=0.0):
+        if _mutation_hook is not None:
+            _mutation_hook(self, "scale_")
         self._data = self._data * scale + bias
         return self
 
     def clip_(self, min=None, max=None):
+        if _mutation_hook is not None:
+            _mutation_hook(self, "clip_")
         self._data = jnp.clip(self._data, min, max)
         return self
 
@@ -353,13 +382,22 @@ class Tensor:
         )
 
     def __bool__(self):
-        return bool(self._data)
+        v = bool(self._data)
+        if _concrete_hook is not None:
+            _concrete_hook(self, "bool", v)
+        return v
 
     def __int__(self):
-        return int(self._data)
+        v = int(self._data)
+        if _concrete_hook is not None:
+            _concrete_hook(self, "int", v)
+        return v
 
     def __float__(self):
-        return float(self._data)
+        v = float(self._data)
+        if _concrete_hook is not None:
+            _concrete_hook(self, "float", v)
+        return v
 
     def __hash__(self):
         return id(self)
@@ -397,6 +435,13 @@ def _as_data(x):
 # installed by paddle_tpu.amp.debugging so the hot path pays one None-check.
 _sanitizer = None
 _op_tracer = None  # profiler hook: fn(op_name, host_seconds) on the waist
+# SOT capture hooks (paddle_tpu.jit.sot): the bytecode-translator analogue
+# records every waist op into a tape (reference SOT hooks the frame
+# evaluator instead, `python/paddle/jit/sot/translate.py:37`). All None
+# when no symbolic_translate capture is active.
+_op_capture = None     # fn(op_fn, in_tensors, cast_arrays, outs, name, grad)
+_concrete_hook = None  # fn(tensor, kind, python_value) on bool/int/float/item
+_mutation_hook = None  # fn(tensor, why) before a non-waist in-place mutation
 
 
 def apply(fn, *tensors, _name="op", _nout=None):
@@ -433,6 +478,8 @@ def apply(fn, *tensors, _name="op", _nout=None):
     outs = list(out) if multi else [out]
     if _sanitizer is not None:
         _sanitizer(_name, outs)
+    if _op_capture is not None:
+        _op_capture(fn, tensors, datas, outs, _name, needs_grad)
     result = [Tensor(o, stop_gradient=not needs_grad) for o in outs]
 
     if needs_grad:

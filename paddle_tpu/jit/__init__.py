@@ -18,10 +18,13 @@ import jax.numpy as jnp
 from paddle_tpu.core.tensor import Tensor, no_grad
 from paddle_tpu.framework import random as _rng
 from paddle_tpu.jit.dy2static import Dy2StaticFallback
+from paddle_tpu.jit import sot
+from paddle_tpu.jit.sot import symbolic_translate, sot_report
 from paddle_tpu.nn.layer.layers import Layer
 
 __all__ = ["to_static", "functionalize", "save", "load", "not_to_static",
-           "TracedLayer", "fallback_count", "fallback_report"]
+           "TracedLayer", "fallback_count", "fallback_report", "sot",
+           "symbolic_translate", "sot_report"]
 
 _fallback_count = 0
 _fallback_records = []
@@ -347,10 +350,19 @@ class StaticFunction:
         return self
 
 
-def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
-    """@paddle.jit.to_static — compile a Layer or function with XLA."""
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              full_graph=None, **kwargs):
+    """@paddle.jit.to_static — compile a Layer or function with XLA.
+
+    full_graph selects the capture path, mirroring the reference's switch
+    (`jit/api.py` to_static full_graph): True/None (default) uses the AST +
+    whole-trace StaticFunction; False uses the SOT symbolic-capture path
+    (`paddle_tpu.jit.sot`), which keeps full Python semantics and falls
+    back per call-path instead of per callable."""
 
     def decorator(fn):
+        if full_graph is False:
+            return symbolic_translate(fn)
         if isinstance(fn, Layer):
             return StaticFunction(fn, input_spec, build_strategy, backend)
         sf = StaticFunction(fn, input_spec, build_strategy, backend)

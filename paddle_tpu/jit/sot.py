@@ -1,0 +1,471 @@
+"""SOT — the second (symbolic-capture) compilation path for dygraph code.
+
+The reference pairs its AST dy2static converter with PaddleSOT, a CPython
+frame-evaluator that simulates bytecode, collects tensor ops into sub-graphs,
+guards the result, and falls back per sub-graph rather than per callable
+(`/root/reference/python/paddle/jit/sot/translate.py:37`,
+`jit/sot/opcode_translator/`). A bytecode simulator is the natural capture
+point when eager ops are opaque C++ kernel launches. In this framework every
+eager op already funnels through ONE Python dispatch waist
+(`paddle_tpu/core/tensor.py` `apply()`), so the TPU-native equivalent hooks
+the waist instead of the frame evaluator:
+
+  capture:   the wrapped function runs EAGERLY (full CPython semantics — any
+             Python construct works: break/continue, generators, closures,
+             numpy on host scalars, data-dependent branches). Every waist op
+             is recorded into a tape; `bool()/int()/float()/item()` on a
+             traced tensor records a GUARD (the reference's graph-break
+             trigger); in-place mutation of a traced tensor or drawing
+             framework RNG mid-trace marks the call uncapturable and it
+             stays eager (the reference's sub-graph fallback, reported).
+  replay:    the tape is split at guards into segments, each compiled with
+             `jax.jit` and re-entered through `apply()` — one fused XLA
+             program replaces hundreds of per-op dispatches, and the eager
+             autograd tape sees one grad node per segment. Guards are
+             re-evaluated between segments on every call: a data-dependent
+             branch costs one device sync, exactly like the reference's
+             break-and-resume.
+  guards:    plans are cached per (input treedef, tensor avals, scalar args)
+             and per guard-outcome vector. A guard flip re-runs eagerly once,
+             captures the new path, and both plans stay cached (the
+             reference's guard-miss -> re-translate). Layer parameters and
+             closure tensors are "externals": the tape holds the Tensor
+             OBJECT and re-reads its array at every replay, so optimizer
+             updates flow into compiled steps.
+
+Semantics notes (the same trade the reference's SOT makes, stated honestly):
+  - Python side effects (prints, list appends) happen at capture only; on
+    replay only tensor compute re-runs — trace semantics, like jax.jit.
+  - int()/float()/item() values are guarded by equality: code that feeds a
+    materialized scalar back into tensor compute recaptures when the scalar
+    changes.
+  - Framework RNG (dropout etc.) inside the traced region forces eager
+    fallback: a taped closure would freeze the mask. Use the AST path
+    (`to_static`) or eval mode for those.
+  - `.numpy()` on a tensor the tape has seen (including inputs/parameters)
+    is a break: the array flows into Python where no guard can follow it.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+
+import jax
+import numpy as np
+
+from paddle_tpu.core import tensor as _tc
+from paddle_tpu.core.tensor import Tensor, apply
+from paddle_tpu.framework import random as _rng
+
+__all__ = ["symbolic_translate", "SotFunction", "sot_report"]
+
+_MAX_PLANS_PER_KEY = 8
+_MAX_KEYS = 64
+
+
+# --------------------------------------------------------------------------
+# tape structures
+# --------------------------------------------------------------------------
+
+
+class _Op:
+    __slots__ = ("fn", "refs", "dtypes", "base", "nout", "name", "grad_on")
+
+    def __init__(self, fn, refs, dtypes, base, nout, name, grad_on):
+        self.fn = fn            # the waist closure, replayed verbatim
+        self.refs = refs        # input refs: ('a',i) arg | ('x',i) ext | ('n',i) node
+        self.dtypes = dtypes    # per-input dtype the waist dispatched with (AMP)
+        self.base = base        # first output node id
+        self.nout = nout
+        self.name = name
+        self.grad_on = grad_on  # False = ran under no_grad: replay must not
+        #                         let the segment vjp flow through it
+
+
+class _Guard:
+    __slots__ = ("ref", "kind", "value")
+
+    def __init__(self, ref, kind, value):
+        self.ref = ref          # ref whose concrete value was read
+        self.kind = kind        # 'bool' | 'int' | 'float' | 'item'
+        self.value = value      # value observed at capture
+
+
+class _Capture:
+    def __init__(self):
+        self.entries = []       # _Op | _Guard, in program order
+        self.refmap = {}        # id(jax.Array) -> ref
+        self.pins = []          # keep arrays alive so ids stay unique
+        self.externals = []     # holder Tensor objects discovered mid-trace
+        self.n_nodes = 0
+        self.broken = None      # fallback reason, or None
+
+    # -- hooks installed on the waist --------------------------------------
+    def on_op(self, fn, tensors, cast, outs, name, grad_on):
+        if self.broken:
+            return
+        refs, dtypes = [], []
+        for t, c in zip(tensors, cast):
+            refs.append(self._ref_for(t))
+            dtypes.append(c.dtype if c.dtype != t._data.dtype else None)
+        self.entries.append(
+            _Op(fn, refs, dtypes, self.n_nodes, len(outs), name, grad_on))
+        for j, o in enumerate(outs):
+            self.refmap[id(o)] = ("n", self.n_nodes + j)
+            self.pins.append(o)
+        self.n_nodes += len(outs)
+
+    def on_concrete(self, t, kind, value):
+        if self.broken:
+            return
+        ref = self.refmap.get(id(t._data))
+        if ref is None:
+            # a branch/scalar read on a tensor the tape has never seen: no
+            # guard can track where its value came from -> not capturable
+            self.broken = (f"{kind}() on a tensor unseen by the tape "
+                           "(produced outside the dispatch waist)")
+            return
+        self.entries.append(_Guard(ref, kind, value))
+
+    def on_mutation(self, t, why):
+        if self.broken:
+            return
+        if id(t._data) in self.refmap:
+            # mutating (or numpy-reading) a tensor the tape has seen would
+            # desync replay from eager semantics
+            self.broken = f"non-waist access to a traced tensor ({why})"
+
+    def on_rng(self):
+        if not self.broken:
+            self.broken = "framework RNG drawn inside the traced region"
+
+    # -- ref resolution ----------------------------------------------------
+    def _ref_for(self, t):
+        ref = self.refmap.get(id(t._data))
+        if ref is None:
+            # first sight of a tensor the tape didn't produce: an implicit
+            # external input (a Layer parameter, a closure tensor, a constant
+            # built inside the function). The holder Tensor is kept and its
+            # array re-read at every replay, so parameter updates flow in.
+            ref = ("x", len(self.externals))
+            self.externals.append(t)
+            self.refmap[id(t._data)] = ref
+            self.pins.append(t._data)
+        return ref
+
+
+# --------------------------------------------------------------------------
+# compiled plan
+# --------------------------------------------------------------------------
+
+
+class _Segment:
+    __slots__ = ("ops", "in_refs", "out_nodes", "guards", "_fn")
+
+    def __init__(self, ops, in_refs, out_nodes, guards):
+        self.ops = ops
+        self.in_refs = in_refs      # ordered refs this segment consumes
+        self.out_nodes = out_nodes  # node ids this segment must emit
+        self.guards = guards        # guards evaluated right after it runs
+        self._fn = None
+
+    def fn(self):
+        if self._fn is None:
+            ops, in_refs, out_nodes = self.ops, self.in_refs, self.out_nodes
+
+            def replay(*arrs):
+                env = dict(zip(in_refs, arrs))
+                for op in ops:
+                    ins = [env[r] if dt is None else env[r].astype(dt)
+                           for r, dt in zip(op.refs, op.dtypes)]
+                    if op.grad_on:
+                        out = op.fn(*ins)
+                    else:
+                        # the op ran under no_grad at capture: cut the vjp
+                        # path the same way the missing grad node would have
+                        out = op.fn(*[jax.lax.stop_gradient(x) for x in ins])
+                    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+                    for j, o in enumerate(outs):
+                        env[("n", op.base + j)] = o
+                # single-node segments return a bare array: the eager
+                # backward engine feeds single-output grad nodes a leaf
+                # cotangent, and jax.vjp requires matching structure
+                if len(out_nodes) == 1:
+                    return env[("n", out_nodes[0])]
+                return tuple(env[("n", n)] for n in out_nodes)
+
+            self._fn = jax.jit(replay)
+        return self._fn
+
+
+class _Plan:
+    __slots__ = ("segments", "externals", "ext_avals", "out_spec",
+                 "guard_vector")
+
+    def __init__(self, capture, out_spec):
+        self.externals = capture.externals
+        self.ext_avals = [(t._data.shape, t._data.dtype)
+                          for t in capture.externals]
+        self.out_spec = out_spec  # (treedef, leaf specs)
+
+        # split the tape at guard groups: ops..., guards..., ops..., ...
+        boundaries = []  # [(ops, guards)]
+        cur_ops, cur_guards = [], []
+        for e in capture.entries:
+            if isinstance(e, _Op):
+                if cur_guards:
+                    boundaries.append((cur_ops, cur_guards))
+                    cur_ops, cur_guards = [], []
+                cur_ops.append(e)
+            else:
+                cur_guards.append(e)
+        boundaries.append((cur_ops, cur_guards))
+        n_seg = len(boundaries)
+
+        # liveness: node -> latest consumer "time". An op in segment sj
+        # consumes at sj; a guard attached to segment sj reads after sj runs
+        # (time sj + 0.5); a returned leaf consumes at n_seg. A node must be
+        # emitted by its producing segment if any consumer time exceeds the
+        # producer's in-segment availability (i.e. it is read by a guard or
+        # by anything in a later segment).
+        produced_in = {}
+        for si, (ops, _) in enumerate(boundaries):
+            for op in ops:
+                for j in range(op.nout):
+                    produced_in[op.base + j] = si
+        last_use = {}
+
+        def use(ref, when):
+            if ref[0] == "n":
+                last_use[ref[1]] = max(last_use.get(ref[1], -1.0), when)
+
+        for si, (ops, guards) in enumerate(boundaries):
+            for op in ops:
+                for r in op.refs:
+                    use(r, float(si))
+            for g in guards:
+                use(g.ref, si + 0.5)
+        treedef, spec = out_spec
+        for lf in spec:
+            if lf[0] == "n":
+                last_use[lf[1]] = float(n_seg)
+
+        self.segments = []
+        for si, (ops, guards) in enumerate(boundaries):
+            in_refs, seen = [], set()
+            for op in ops:
+                for r in op.refs:
+                    crosses = r[0] != "n" or produced_in[r[1]] != si
+                    if crosses and r not in seen:
+                        seen.add(r)
+                        in_refs.append(r)
+            out_nodes = sorted(
+                n for n, sp in produced_in.items()
+                if sp == si and last_use.get(n, -1.0) > si)
+            self.segments.append(_Segment(ops, in_refs, out_nodes, guards))
+        self.guard_vector = tuple(
+            g.value for _, guards in boundaries for g in guards)
+
+
+# --------------------------------------------------------------------------
+# the translated callable
+# --------------------------------------------------------------------------
+
+
+def _base_key(args, kwargs):
+    leaves, treedef = jax.tree.flatten((args, kwargs))
+    parts = []
+    for lf in leaves:
+        if isinstance(lf, Tensor):
+            parts.append(("T", lf._data.shape, str(lf._data.dtype),
+                          lf.stop_gradient))
+        elif isinstance(lf, (np.ndarray, jax.Array)):
+            parts.append(("A", lf.shape, str(lf.dtype)))
+        else:
+            try:
+                hash(lf)
+                parts.append(lf)
+            except TypeError:
+                parts.append(repr(lf))
+    return (treedef, tuple(parts))
+
+
+class SotFunction:
+    """Callable produced by `symbolic_translate` (the reference's
+    `jit/sot/translate.py:37` return value)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        self._plans = OrderedDict()     # base_key -> [plans, MRU first]
+        self._uncapturable = {}         # base_key -> reason
+        self.stats = {"captures": 0, "hits": 0, "guard_restarts": 0,
+                      "eager_calls": 0, "fallbacks": {}}
+        functools.update_wrapper(
+            self, fn, assigned=("__name__", "__doc__", "__qualname__"),
+            updated=())
+
+    # -- capture -----------------------------------------------------------
+    def _capture(self, key, args, kwargs):
+        if _tc._op_capture is not None:
+            # nested translate: let the OUTER capture record our ops
+            return self._fn(*args, **kwargs)
+        cap = _Capture()
+        leaves, _ = jax.tree.flatten((args, kwargs))
+        n_args = 0
+        for lf in leaves:
+            if isinstance(lf, Tensor):
+                cap.refmap[id(lf._data)] = ("a", n_args)
+                cap.pins.append(lf._data)
+                n_args += 1
+
+        orig_next_key = _rng.next_key
+
+        def traced_next_key(*a, **k):
+            cap.on_rng()
+            return orig_next_key(*a, **k)
+
+        _tc._op_capture = self._waist_hook(cap)
+        _tc._concrete_hook = cap.on_concrete
+        _tc._mutation_hook = cap.on_mutation
+        _rng.next_key = traced_next_key
+        try:
+            result = self._fn(*args, **kwargs)
+        finally:
+            _tc._op_capture = None
+            _tc._concrete_hook = None
+            _tc._mutation_hook = None
+            _rng.next_key = orig_next_key
+
+        if cap.broken is None:
+            out_leaves, out_def = jax.tree.flatten(result)
+            spec = []
+            for lf in out_leaves:
+                if isinstance(lf, Tensor):
+                    ref = cap.refmap.get(id(lf._data))
+                    if ref is None:
+                        cap.broken = ("an output tensor was produced outside "
+                                      "the dispatch waist")
+                        break
+                    spec.append(ref + (lf.stop_gradient,))
+                else:
+                    spec.append(("c", lf))
+            if cap.broken is None:
+                plan = _Plan(cap, (out_def, spec))
+                plans = self._plans.setdefault(key, [])
+                plans.insert(0, plan)
+                del plans[_MAX_PLANS_PER_KEY:]
+                self._plans.move_to_end(key)
+                while len(self._plans) > _MAX_KEYS:
+                    self._plans.popitem(last=False)
+                self.stats["captures"] += 1
+        if cap.broken is not None:
+            self._uncapturable[key] = cap.broken
+            self.stats["fallbacks"][cap.broken] = \
+                self.stats["fallbacks"].get(cap.broken, 0) + 1
+        return result
+
+    @staticmethod
+    def _waist_hook(cap):
+        def hook(fn, tensors, cast, outs, name, needs_grad):
+            cap.on_op(fn, tensors, cast, outs, name, needs_grad)
+        return hook
+
+    # -- replay ------------------------------------------------------------
+    def _try_replay(self, plan, arg_tensors):
+        """Run one plan's segments; None if a guard/aval mismatch occurs."""
+        ext = plan.externals
+        for t, (shape, dtype) in zip(ext, plan.ext_avals):
+            if t._data.shape != shape or t._data.dtype != dtype:
+                return None
+        env = {}
+
+        def resolve(ref):
+            kind, idx = ref
+            if kind == "a":
+                return arg_tensors[idx]
+            if kind == "x":
+                return ext[idx]
+            return env[idx]
+
+        for seg in plan.segments:
+            if seg.ops:
+                ins = [resolve(r) for r in seg.in_refs]
+                outs = apply(seg.fn(), *ins, _name="sot_segment")
+                if not isinstance(outs, list):
+                    outs = [outs]
+                for n, t in zip(seg.out_nodes, outs):
+                    env[n] = t
+            for g in seg.guards:
+                raw = np.asarray(resolve(g.ref)._data)
+                got = {"bool": lambda: bool(raw), "int": lambda: int(raw),
+                       "float": lambda: float(raw),
+                       "item": lambda: raw.item()}[g.kind]()
+                if got != g.value:
+                    return None
+        treedef, spec = plan.out_spec
+        out_leaves = []
+        for lf in spec:
+            if lf[0] == "c":
+                out_leaves.append(lf[1])
+                continue
+            kind, idx, stop_grad = lf
+            t = resolve((kind, idx))
+            if t.stop_gradient != stop_grad:
+                t2 = Tensor(t._data, stop_gradient=stop_grad)
+                t2._node, t2._out_idx = t._node, t._out_idx
+                t = t2
+            out_leaves.append(t)
+        return (jax.tree.unflatten(treedef, out_leaves),)
+
+    def __call__(self, *args, **kwargs):
+        key = _base_key(args, kwargs)
+        if key in self._uncapturable:
+            self.stats["eager_calls"] += 1
+            return self._fn(*args, **kwargs)
+        plans = self._plans.get(key)
+        if not plans:
+            return self._capture(key, args, kwargs)
+        leaves, _ = jax.tree.flatten((args, kwargs))
+        arg_tensors = [lf for lf in leaves if isinstance(lf, Tensor)]
+        for i, plan in enumerate(plans):
+            res = self._try_replay(plan, arg_tensors)
+            if res is not None:
+                if i:
+                    plans.insert(0, plans.pop(i))  # MRU
+                self.stats["hits"] += 1
+                return res[0]
+            self.stats["guard_restarts"] += 1
+        # no recorded path matches this call's guard outcomes: take the
+        # eager road once and remember the new path
+        return self._capture(key, args, kwargs)
+
+    # -- reporting (reference GraphLogger/InfoCollector role) --------------
+    def report(self):
+        return {"function": getattr(self._fn, "__qualname__", str(self._fn)),
+                "plans": sum(len(v) for v in self._plans.values()),
+                "keys": len(self._plans),
+                "uncapturable": sorted(set(self._uncapturable.values())),
+                **self.stats}
+
+
+_registry = []
+
+
+def symbolic_translate(fn, **kwargs):
+    """Entry point of the SOT path (reference `jit/sot/translate.py:37`).
+
+    Works on plain functions, bound methods, and Layers (a Layer's
+    parameters become tape externals, so optimizer updates are picked up
+    by replay automatically)."""
+    from paddle_tpu.nn import Layer
+
+    sf = SotFunction(fn.__call__ if isinstance(fn, Layer) else fn)
+    _registry.append(sf)
+    return sf
+
+
+def sot_report():
+    """Aggregate capture/guard/fallback stats over every translated function
+    (the reference's `paddle.jit.sot` InfoCollector summary)."""
+    return [sf.report() for sf in _registry]

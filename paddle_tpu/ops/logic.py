@@ -1,15 +1,22 @@
-"""Comparison/logical ops (reference: `python/paddle/tensor/logic.py`)."""
+"""Comparison/logical ops (reference: `python/paddle/tensor/logic.py`).
+
+All of these dispatch through the `apply` waist even though none are
+differentiable: the waist is also where the nan/inf sanitizer, the
+profiler's per-op tracer, and the SOT capture tape observe ops (reference
+equivalent: comparison kernels are ordinary phi kernels and hence visible
+to every interceptor on the kernel path)."""
 
 import jax.numpy as jnp
 
-from paddle_tpu.core.tensor import Tensor, to_tensor
+from paddle_tpu.core.tensor import Tensor, apply, to_tensor
 
 
 def _cmp(jfn, name):
     def op(x, y, name=None):
-        a = x._data if isinstance(x, Tensor) else x
-        b = y._data if isinstance(y, Tensor) else y
-        return Tensor(jfn(a, b))
+        xt = x if isinstance(x, Tensor) else to_tensor(x)
+        if isinstance(y, Tensor):
+            return apply(jfn, xt, y, _name=op.__name__)
+        return apply(lambda a: jfn(a, y), xt, _name=op.__name__)
 
     op.__name__ = name
     return op
@@ -27,21 +34,25 @@ logical_xor = _cmp(jnp.logical_xor, "logical_xor")
 
 
 def logical_not(x, out=None, name=None):
-    return Tensor(jnp.logical_not(x._data))
+    return apply(jnp.logical_not, x, _name="logical_not")
 
 
 def equal_all(x, y, name=None):
-    return Tensor(jnp.array_equal(x._data, y._data))
+    return apply(jnp.array_equal, x, y, _name="equal_all")
 
 
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return Tensor(jnp.allclose(x._data, y._data, rtol=float(rtol), atol=float(atol),
-                               equal_nan=equal_nan))
+    rt, at = float(rtol), float(atol)
+    return apply(
+        lambda a, b: jnp.allclose(a, b, rtol=rt, atol=at, equal_nan=equal_nan),
+        x, y, _name="allclose")
 
 
 def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
-    return Tensor(jnp.isclose(x._data, y._data, rtol=float(rtol), atol=float(atol),
-                              equal_nan=equal_nan))
+    rt, at = float(rtol), float(atol)
+    return apply(
+        lambda a, b: jnp.isclose(a, b, rtol=rt, atol=at, equal_nan=equal_nan),
+        x, y, _name="isclose")
 
 
 def is_tensor(x):
@@ -49,8 +60,9 @@ def is_tensor(x):
 
 
 def is_empty(x, name=None):
-    return Tensor(jnp.asarray(x.size == 0))
+    empty = x.size == 0  # static property of the shape
+    return apply(lambda a: jnp.asarray(empty), x, _name="is_empty")
 
 
 def isreal(x, name=None):
-    return Tensor(jnp.isreal(x._data))
+    return apply(jnp.isreal, x, _name="isreal")
