@@ -1,0 +1,96 @@
+"""Generated `_C_ops` binding layer (reference `python_c_gen.py:119` /
+`python/paddle/_C_ops.py`).
+
+Two properties under test:
+  1. freshness — the committed module is byte-identical to what the
+     generator emits from the reference schema, so the yaml stays the
+     single source of truth (drift fails CI, the codegen-spine guarantee
+     SURVEY §2.3 attributes to the reference's build);
+  2. call-convention parity — `_C_ops.*` accepts the KERNEL argument
+     list in yaml order, the way reference internals call it
+     (`python/paddle/tensor/linalg.py:320` `_C_ops.matmul(x, y, False,
+     False)`), and agrees with the public API.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import _C_ops
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x, dtype=np.float32))
+
+
+class TestFreshness:
+    def test_generated_module_matches_schema(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        try:
+            import c_ops_gen
+            import op_schema
+        finally:
+            sys.path.pop(0)
+        if not os.path.exists(op_schema.REF_YAML):
+            pytest.skip("reference yaml unavailable")
+        src, emitted = c_ops_gen.generate()
+        committed = open(os.path.join(REPO, "paddle_tpu", "_C_ops.py")).read()
+        assert committed == src, (
+            "paddle_tpu/_C_ops.py is stale — regenerate with "
+            "`python tools/c_ops_gen.py --write`")
+        assert len(emitted) >= 300
+
+    def test_surface_size(self):
+        assert len(_C_ops.__all__) >= 300
+        # staples of the generated surface
+        for name in ("matmul", "abs", "argmax", "softmax", "mean", "full_"):
+            assert hasattr(_C_ops, name), name
+
+
+class TestCallConvention:
+    def test_matmul_yaml_positional(self):
+        x, y = t(np.ones((2, 3))), t(np.ones((3, 4)))
+        out = _C_ops.matmul(x, y, False, False)
+        np.testing.assert_allclose(out.numpy(), np.full((2, 4), 3.0))
+
+    def test_matmul_transpose_flags(self):
+        x, y = t(np.ones((3, 2))), t(np.ones((3, 4)))
+        out = _C_ops.matmul(x, y, True, False)
+        np.testing.assert_allclose(out.numpy(), np.full((2, 4), 3.0))
+
+    def test_agrees_with_public_api(self):
+        a = t([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(_C_ops.softmax(a, -1).numpy(),
+                                   paddle.nn.functional.softmax(a).numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(_C_ops.mean(a, [-1], False).numpy(),
+                                   paddle.mean(a, axis=-1).numpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            _C_ops.argmax(a, 1, False, False).numpy(),
+            paddle.argmax(a, axis=1).numpy())
+
+    def test_defaults_from_yaml(self):
+        a = t([[1.0, -2.0]])
+        # leaky_relu yaml default negative_slope=0.02 is overridden by the
+        # python api to 0.01 — the generated binding forwards the yaml-order
+        # value explicitly, so passing it must work
+        out = _C_ops.leaky_relu(a, 0.5)
+        np.testing.assert_allclose(out.numpy(), [[1.0, -1.0]])
+
+    def test_kernel_only_args_swallowed(self):
+        # dropout's kernel schema carries seed plumbing the python api fills
+        # internally; the generated binding accepts and drops them
+        x = t(np.ones((4, 4)))
+        out = _C_ops.dropout(x, None, 0.0, False, "upscale_in_train", 0,
+                             False)
+        np.testing.assert_allclose(out.numpy(), np.ones((4, 4)))
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

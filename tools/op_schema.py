@@ -39,12 +39,14 @@ _NAME_EQUIV = {
     "axis": ("axis", "dim"),
     "dtype": ("dtype",),
     "keepdim": ("keepdim", "keepdims"),
+    "value": ("value", "fill_value"),
 }
 
 # kernel-schema args the reference's own PYTHON api does not expose (its
 # generated python wrappers fill them internally) — conformance targets the
 # python surface, so these never count as missing. op -> arg names.
 _KERNEL_ONLY = {
+    "full_": {"output", "place"},  # inplace out-var + legacy Place attr
     "cumsum": {"flatten", "exclusive", "reverse"},
     "logcumsumexp": {"flatten", "exclusive", "reverse"},
     "dropout": {"seed_tensor", "is_test", "seed", "fix_seed"},
@@ -86,9 +88,13 @@ class OpSchema:
 
 
 # parts are already comma-split with bracket/brace depth respected, so the
-# default capture may contain commas (e.g. `int[] strides={1, 1}`)
+# default capture may contain commas (e.g. `int[] strides={1, 1}`). The
+# type may carry a parenthesized precision like `Scalar(int64_t)` or
+# `IntArray(int64_t)` — without that group the arg used to be DROPPED,
+# hiding e.g. argmax's axis from conformance and codegen.
 _ARG_RE = re.compile(
-    r"\s*([\w<>\[\]]+(?:\s*\[\])?)\s+(\w+)\s*(?:=\s*(.+))?$")
+    r"\s*([\w<>\[\]]+(?:\([\w<>\[\]\s,]*\))?(?:\s*\[\])?)\s+(\w+)"
+    r"\s*(?:=\s*(.+))?$")
 
 
 def _parse_args(argstr):
@@ -135,15 +141,28 @@ def load_schemas(path=REF_YAML):
     schemas = {}
     for e in entries:
         name = e.split("\n", 1)[0].strip()
-        # args may wrap over multiple yaml lines: capture from "(" to the
-        # matching close across newlines
-        argm = re.search(r"^\s*args\s*:\s*(\([^)]*\))", e, re.M | re.S)
+        # args may wrap over multiple yaml lines AND contain nested parens
+        # (`Scalar(int64_t) axis`): scan from "(" to the BALANCED close —
+        # a first-")" regex silently truncated such arg lists
+        argm = None
+        m0 = re.search(r"^\s*args\s*:\s*\(", e, re.M)
+        if m0:
+            start = m0.end() - 1
+            depth = 0
+            for i in range(start, len(e)):
+                if e[i] == "(":
+                    depth += 1
+                elif e[i] == ")":
+                    depth -= 1
+                    if depth == 0:
+                        argm = e[start:i + 1]
+                        break
         outm = re.search(r"^\s*output\s*:\s*(.+)$", e, re.M)
         bwm = re.search(r"^\s*backward\s*:\s*(\w+)", e, re.M)
         inpm = re.search(r"^\s*inplace\s*:\s*\((.+?)\)", e, re.M)
         schemas[name] = OpSchema(
             name,
-            _parse_args(argm.group(1)) if argm else [],
+            _parse_args(argm) if argm else [],
             _parse_outputs(outm.group(1)) if outm else [],
             bwm.group(1) if bwm else None,
             inpm.group(1) if inpm else None,
