@@ -68,6 +68,18 @@ def next_key():
     return sub
 
 
+def next_key_tensor():
+    """A fresh PRNG key as a (stop-gradient) Tensor, for RNG ops that route
+    the key through the dispatch waist as a real input instead of closing
+    over it. That makes the draw VISIBLE to waist interceptors — in
+    particular `paddle_tpu.jit.sot` marks such keys refresh-on-replay, so a
+    captured dropout re-draws its mask every compiled step exactly like
+    eager (a closed-over key would freeze the mask into the tape)."""
+    from paddle_tpu.core.tensor import Tensor
+
+    return Tensor(next_key())
+
+
 def get_cuda_rng_state():
     return [get_rng_state()]
 

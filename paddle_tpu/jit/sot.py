@@ -98,6 +98,8 @@ class _Capture:
         self.refmap = {}        # id(jax.Array) -> ref
         self.pins = []          # keep arrays alive so ids stay unique
         self.externals = []     # holder Tensor objects discovered mid-trace
+        self.ext_rng = []       # parallel: True = PRNG key, refresh on replay
+        self.rng_key_ids = set()  # array ids returned by next_key_tensor
         self.n_nodes = 0
         self.broken = None      # fallback reason, or None
 
@@ -148,8 +150,12 @@ class _Capture:
             # external input (a Layer parameter, a closure tensor, a constant
             # built inside the function). The holder Tensor is kept and its
             # array re-read at every replay, so parameter updates flow in.
+            # PRNG keys from next_key_tensor are flagged: replay draws a
+            # FRESH key instead — dropout masks vary per compiled step, same
+            # as eager.
             ref = ("x", len(self.externals))
             self.externals.append(t)
+            self.ext_rng.append(id(t._data) in self.rng_key_ids)
             self.refmap[id(t._data)] = ref
             self.pins.append(t._data)
         return ref
@@ -200,13 +206,14 @@ class _Segment:
 
 
 class _Plan:
-    __slots__ = ("segments", "externals", "ext_avals", "out_spec",
+    __slots__ = ("segments", "externals", "ext_avals", "ext_rng", "out_spec",
                  "guard_vector")
 
     def __init__(self, capture, out_spec):
         self.externals = capture.externals
         self.ext_avals = [(t._data.shape, t._data.dtype)
                           for t in capture.externals]
+        self.ext_rng = capture.ext_rng
         self.out_spec = out_spec  # (treedef, leaf specs)
 
         # split the tape at guard groups: ops..., guards..., ops..., ...
@@ -320,15 +327,32 @@ class SotFunction:
                 n_args += 1
 
         orig_next_key = _rng.next_key
+        orig_next_key_tensor = _rng.next_key_tensor
+        in_key_tensor = [False]
 
         def traced_next_key(*a, **k):
-            cap.on_rng()
+            # a raw (closure-bound) key draw cannot be replayed -> break;
+            # draws routed through next_key_tensor become refreshable
+            # externals instead
+            if not in_key_tensor[0]:
+                cap.on_rng()
             return orig_next_key(*a, **k)
+
+        def traced_next_key_tensor(*a, **k):
+            in_key_tensor[0] = True
+            try:
+                t = orig_next_key_tensor(*a, **k)
+            finally:
+                in_key_tensor[0] = False
+            cap.rng_key_ids.add(id(t._data))
+            cap.pins.append(t._data)
+            return t
 
         _tc._op_capture = self._waist_hook(cap)
         _tc._concrete_hook = cap.on_concrete
         _tc._mutation_hook = cap.on_mutation
         _rng.next_key = traced_next_key
+        _rng.next_key_tensor = traced_next_key_tensor
         try:
             result = self._fn(*args, **kwargs)
         finally:
@@ -336,6 +360,7 @@ class SotFunction:
             _tc._concrete_hook = None
             _tc._mutation_hook = None
             _rng.next_key = orig_next_key
+            _rng.next_key_tensor = orig_next_key_tensor
 
         if cap.broken is None:
             out_leaves, out_def = jax.tree.flatten(result)
@@ -385,6 +410,8 @@ class SotFunction:
             if kind == "a":
                 return arg_tensors[idx]
             if kind == "x":
+                if plan.ext_rng[idx]:
+                    return _rng.next_key_tensor()  # fresh mask per replay
                 return ext[idx]
             return env[idx]
 

@@ -68,11 +68,13 @@ def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
     if training:
         from paddle_tpu.framework import random as _rng
 
-        def fn(a):
-            neg = jax.random.uniform(_rng.next_key(), a.shape, a.dtype, lower, upper)
+        key_t = _rng.next_key_tensor()
+
+        def fn(a, key):
+            neg = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
             return jnp.where(a >= 0, a, neg * a)
 
-        return apply(fn, x, _name="rrelu")
+        return apply(fn, x, key_t, _name="rrelu")
     mid = (lower + upper) / 2.0
     return apply(lambda a: jnp.where(a >= 0, a, mid * a), x, _name="rrelu")
 
@@ -161,9 +163,9 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from paddle_tpu.framework import random as _rng
 
-    key = _rng.next_key()
+    key_t = _rng.next_key_tensor()
 
-    def fn(a):
+    def fn(a, key):
         g = jax.random.gumbel(key, a.shape, a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
@@ -177,7 +179,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             return jax.lax.stop_gradient(hard_y - y) + y
         return y
 
-    return apply(fn, x, _name="gumbel_softmax")
+    return apply(fn, x, key_t, _name="gumbel_softmax")
 
 
 def maxout(x, groups, axis=1, name=None):

@@ -20,9 +20,11 @@ def linear(x, weight, bias=None, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     if not training or p == 0.0:
         return x if mode == "upscale_in_train" else x * (1.0 - p)
-    key = _rng.next_key()
+    # the key rides the waist as a real input (not a closure): SOT capture
+    # marks it refresh-on-replay so compiled steps re-draw the mask
+    key_t = _rng.next_key_tensor()
 
-    def fn(a):
+    def fn(a, key):
         shape = list(a.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
@@ -32,7 +34,7 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=No
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
 
-    return apply(fn, x, _name="dropout")
+    return apply(fn, x, key_t, _name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -53,13 +55,13 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     alpha_p = -alpha * scale
     a = (1.0 - p + p * alpha_p ** 2 * (1.0 - p)) ** -0.5
     b = -a * alpha_p * p
-    key = _rng.next_key()
+    key_t = _rng.next_key_tensor()
 
-    def fn(t):
+    def fn(t, key):
         keep = jax.random.bernoulli(key, 1.0 - p, t.shape)
         return (a * jnp.where(keep, t, alpha_p) + b).astype(t.dtype)
 
-    return apply(fn, x, _name="alpha_dropout")
+    return apply(fn, x, key_t, _name="alpha_dropout")
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, max_norm=None, norm_type=2.0, name=None):

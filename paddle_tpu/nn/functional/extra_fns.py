@@ -175,15 +175,16 @@ def feature_alpha_dropout(x, p=0.5, training=True, name=None):
     from paddle_tpu.framework import random as _rng
 
     alpha_p = -1.7580993408473766
+    key_t = _rng.next_key_tensor()
 
-    def fn(a):
+    def fn(a, key):
         shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
-        keep = jax.random.bernoulli(_rng.next_key(), 1 - p, shape)
+        keep = jax.random.bernoulli(key, 1 - p, shape)
         A = (1 - p + p * alpha_p ** 2) ** -0.5
         B = -A * p * alpha_p
         return A * jnp.where(keep, a, alpha_p) + B
 
-    return apply(fn, x, _name="feature_alpha_dropout")
+    return apply(fn, x, key_t, _name="feature_alpha_dropout")
 
 
 def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,

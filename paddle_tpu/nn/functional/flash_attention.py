@@ -69,15 +69,23 @@ def _use_pallas(q, k, dropout=0.0, training=True, mask=None):
 def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
                     fixed_seed_offset=None, rng_name="", training=True, name=None):
     drop = dropout if training else 0.0
-    dropout_key = _rng.next_key() if drop > 0.0 else None
+    if drop > 0.0:
+        # key rides the waist (SOT marks it refresh-on-replay)
+        key_t = _rng.next_key_tensor()
+
+        def fn_d(q, k, v, dkey):
+            return _sdpa_reference(q, k, v, causal=causal, dropout=drop,
+                                   dropout_key=dkey)
+
+        out = apply(fn_d, query, key, value, key_t, _name="flash_attention")
+        return out, None
 
     def fn(q, k, v):
         if _use_pallas(q, k, dropout=drop, training=training):
             from paddle_tpu.kernels.flash_attention import flash_attention_fwd
 
             return flash_attention_fwd(q, k, v, causal=causal)
-        return _sdpa_reference(q, k, v, causal=causal, dropout=drop,
-                               dropout_key=dropout_key)
+        return _sdpa_reference(q, k, v, causal=causal, dropout=drop)
 
     out = apply(fn, query, key, value, _name="flash_attention")
     return out, None
@@ -116,8 +124,10 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                     if isinstance(cu_seqlens_k, Tensor) else cu_seqlens_k)
     nseq = len(cq) - 1
     mq, mk = int(max_seqlen_q), int(max_seqlen_k)
+    drop = dropout if training else 0.0
+    key_t = _rng.next_key_tensor() if drop > 0.0 else None
 
-    def fn(qa, ka, va):
+    def fn(qa, ka, va, *maybe_key):
         def pad_batch(a, cu, m):
             h, d = a.shape[1], a.shape[2]
             out = jnp.zeros((nseq, m, h, d), a.dtype)
@@ -133,12 +143,13 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         kmask = (jnp.arange(mk)[None, :] < klens[:, None])
         bias = jnp.where(kmask, 0.0, -jnp.inf)[:, None, None, :]
         out = _sdpa_reference(qb, kb, vb, causal=causal, mask=bias,
-                              dropout=dropout if training else 0.0,
-                              scale=scale)
+                              dropout=drop, scale=scale,
+                              dropout_key=maybe_key[0] if maybe_key else None)
         return jnp.concatenate(
             [out[i, :int(cq[i + 1] - cq[i])] for i in range(nseq)], axis=0)
 
-    out = apply(fn, query, key, value, _name="flash_attn_unpadded")
+    extra = (key_t,) if key_t is not None else ()
+    out = apply(fn, query, key, value, *extra, _name="flash_attn_unpadded")
     return out, None
 
 
@@ -165,15 +176,21 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                                  is_causal=False, training=True, name=None):
     m = attn_mask._data if isinstance(attn_mask, Tensor) else attn_mask
     drop = dropout_p if training else 0.0
-    dropout_key = _rng.next_key() if drop > 0.0 else None
+    if drop > 0.0:
+        key_t = _rng.next_key_tensor()
+
+        def fn_d(q, k, v, dkey):
+            return _sdpa_reference(q, k, v, causal=is_causal, mask=m,
+                                   dropout=drop, dropout_key=dkey)
+
+        return apply(fn_d, query, key, value, key_t, _name="sdpa")
 
     def fn(q, k, v):
         if _use_pallas(q, k, dropout=drop, training=training, mask=m):
             from paddle_tpu.kernels.flash_attention import flash_attention_fwd
 
             return flash_attention_fwd(q, k, v, causal=is_causal)
-        return _sdpa_reference(q, k, v, causal=is_causal, mask=m, dropout=drop,
-                               dropout_key=dropout_key)
+        return _sdpa_reference(q, k, v, causal=is_causal, mask=m, dropout=drop)
 
     return apply(fn, query, key, value, _name="sdpa")
 

@@ -213,20 +213,45 @@ class TestAutograd:
 
 
 class TestFallbacks:
-    def test_rng_falls_back(self):
+    def test_dropout_captures_with_fresh_masks(self):
+        # dropout routes its PRNG key through the waist
+        # (framework.random.next_key_tensor), so SOT captures it and
+        # refreshes the key per replay — compiled steps get fresh masks
         def f(x):
             h = x * 2.0
             return paddle.nn.functional.dropout(h, p=0.5, training=True)
 
         sf = symbolic_translate(f)
         x = t(np.ones((100,)))
-        a = sf(x)
-        b = sf(x)
-        assert a.shape == [100]
-        # dropout must differ between calls (mask NOT frozen into a tape)
+        a = sf(x)   # capture
+        b = sf(x)   # replay 1
+        c = sf(x)   # replay 2
+        assert sf.stats["captures"] == 1 and sf.stats["hits"] == 2
+        # masks must differ between calls (key NOT frozen into the tape)
         assert not np.allclose(a.numpy(), b.numpy())
-        rep = sf.report()
-        assert any("RNG" in r for r in rep["uncapturable"])
+        assert not np.allclose(b.numpy(), c.numpy())
+        # and each output is a valid dropout of 2x: zeros or 4x
+        bn = b.numpy()
+        assert set(np.round(np.unique(bn), 3)).issubset({0.0, 4.0})
+
+    def test_raw_closure_rng_falls_back(self):
+        # an op drawing next_key() into a closure (not via next_key_tensor)
+        # still breaks capture — the honest fallback path
+        from paddle_tpu.core.tensor import apply as _apply
+        from paddle_tpu.framework import random as _rng
+        import jax
+
+        def f(x):
+            key = _rng.next_key()
+            return _apply(
+                lambda a: a + jax.random.uniform(key, a.shape), x,
+                _name="custom_rng")
+
+        sf = symbolic_translate(f)
+        x = t(np.zeros((4,)))
+        sf(x)
+        sf(x)
+        assert any("RNG" in r for r in sf.report()["uncapturable"])
         assert sf.stats["eager_calls"] >= 1
 
     def test_eval_mode_dropout_captures(self):
