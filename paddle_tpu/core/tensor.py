@@ -127,12 +127,15 @@ def _is_float_dtype(dt):
 class Tensor:
     """A paddle-like eager tensor backed by a jax.Array."""
 
-    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx", "name", "persistable", "__weakref__")
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
+                 "name", "persistable", "_st_ref", "__weakref__")
 
     def __init__(self, data, stop_gradient=True, name=None):
         if isinstance(data, Tensor):
             data = data._data
-        if not isinstance(data, jax.Array):
+        if not isinstance(data, (jax.Array, jax.ShapeDtypeStruct)):
+            # ShapeDtypeStruct: static-graph Variables carry an aval, not a
+            # value (paddle_tpu.static.graph)
             data = jnp.asarray(data)
         self._data = data
         self.stop_gradient = stop_gradient
@@ -197,6 +200,10 @@ class Tensor:
     def numpy(self):
         if _mutation_hook is not None:
             _mutation_hook(self, "numpy() materialization")
+        if isinstance(self._data, jax.ShapeDtypeStruct):
+            raise RuntimeError(
+                "this is a static-graph Variable (no value at build time); "
+                "fetch it through Executor.run(fetch_list=[...])")
         return np.asarray(self._data)
 
     def item(self, *args):
@@ -442,6 +449,10 @@ _op_tracer = None  # profiler hook: fn(op_name, host_seconds) on the waist
 _op_capture = None     # fn(op_fn, in_tensors, cast_arrays, outs, name, grad)
 _concrete_hook = None  # fn(tensor, kind, python_value) on bool/int/float/item
 _mutation_hook = None  # fn(tensor, why) before a non-waist in-place mutation
+# Static-graph recorder (paddle_tpu.static.graph): when set AND an input is
+# an abstract Variable, the waist records the op into the active Program
+# (eval_shape only, no execution) instead of running it.
+_static_tape = None
 
 
 def apply(fn, *tensors, _name="op", _nout=None):
@@ -451,6 +462,10 @@ def apply(fn, *tensors, _name="op", _nout=None):
     AMP hook: when an auto_cast scope is active (analogue of the reference's
     AMP logic inside generated ad_funcs, `eager_gen.py:2003-2028`), float32
     inputs to white-list ops are cast to the amp dtype before dispatch."""
+    if _static_tape is not None:
+        recorded = _static_tape.record(fn, tensors, _name)
+        if recorded is not None:
+            return recorded
     datas = [t._data for t in tensors]
 
     from paddle_tpu import amp as _amp

@@ -406,13 +406,20 @@ _static_mode = [False]
 
 
 def enable_static():
-    """Accepted-for-compat: there is no separate static executor on this
-    build — static APIs run through jit tracing (see paddle.static)."""
+    """Enter static-graph mode (reference `paddle.enable_static`): ops on
+    `static.data` Variables are RECORDED into the default Program instead of
+    executing; run them with `static.Executor` (paddle_tpu/static/graph.py)."""
+    from paddle_tpu.static.graph import enable_static_graph
+
     _static_mode[0] = True
+    enable_static_graph()
 
 
 def disable_static():
+    from paddle_tpu.static.graph import disable_static_graph
+
     _static_mode[0] = False
+    disable_static_graph()
 
 
 def in_dynamic_mode():

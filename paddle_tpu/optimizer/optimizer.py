@@ -135,6 +135,15 @@ class Optimizer:
                 self._update_param(p, g, plr)
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        if getattr(loss, "_st_ref", None) is not None:
+            # static-graph mode: record the update on the Program; the
+            # Executor compiles grads + the functional optimizer rule into
+            # the train step (reference: minimize appends backward +
+            # optimizer ops to the ProgramDesc)
+            from paddle_tpu.static.graph import default_main_program
+
+            default_main_program().record_minimize(self, loss)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

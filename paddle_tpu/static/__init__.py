@@ -5,13 +5,16 @@ save_inference_model, `static/io.py:513`).
 
 TPU-native design: the reference's static graph is a ProgramDesc interpreted
 by `PirInterpreter` (`pir_interpreter.cc:1492`). Under XLA the natural
-"static program" is a traced+compiled function, so this module maps the
-static API onto jit tracing: `InputSpec` describes placeholders,
-`save_inference_model` exports StableHLO via `paddle_tpu.jit.save`, and
-`load_inference_model`/`Executor.run` execute through the inference
-Predictor. Program/program_guard are accepted for source compatibility and
-behave as an eager scope (every op executed under them runs eagerly; the
-compiled path is `paddle_tpu.jit.to_static`).
+"static program" is a deferred tape compiled to ONE jitted function: with
+`paddle.enable_static()`, `static.data` creates abstract Variables
+(aval-only Tensors), every op on them is RECORDED into the active Program
+via the dispatch waist (`jax.eval_shape`, zero flops at build — the
+ProgramDesc-building role), and `Executor.run(feed, fetch_list)` compiles
+feed->fetch (plus the optimizer update when `minimize(loss)` was recorded)
+with `jax.jit`, cached per feed-shape signature. See
+`paddle_tpu/static/graph.py`. `save_inference_model` exports StableHLO via
+`paddle_tpu.jit.save`; `load_inference_model`/Executor execute through the
+inference Predictor.
 """
 
 from __future__ import annotations
@@ -19,6 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = [
+    "nn",
     "InputSpec", "Program", "program_guard", "default_main_program",
     "default_startup_program", "data", "Executor", "global_scope",
     "scope_guard", "save_inference_model", "load_inference_model",
@@ -52,63 +56,13 @@ class InputSpec:
         return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
 
 
-_warned_static_noop = False
-
-
-def _warn_static_noop(api):
-    """Static-graph capture is a different execution model; on this build
-    ops under these guards run EAGERLY (jit/to_static is the compiled
-    path). Warn once instead of silently diverging."""
-    global _warned_static_noop
-    if not _warned_static_noop:
-        import warnings
-
-        warnings.warn(
-            f"paddle.static.{api}: static-graph capture is not implemented "
-            "on the TPU build — ops run eagerly with identical math; use "
-            "paddle.jit.to_static / jit.save for the compiled path. "
-            "(warned once)", stacklevel=3)
-        _warned_static_noop = True
-
-
-class Program:
-    """Source-compat Program object; ops under its guard run eagerly."""
-
-    def __init__(self):
-        self._feed_names = []
-        self._fetch = []
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
-
-    def all_parameters(self):
-        return []
-
-
-_main_program = Program()
-_startup_program = Program()
-
-
-def default_main_program():
-    return _main_program
-
-
-def default_startup_program():
-    return _startup_program
-
-
-class program_guard:
-    def __init__(self, main_program=None, startup_program=None):
-        _warn_static_noop("program_guard")
-
-    def __enter__(self):
-        return self
-
-    def __exit__(self, *exc):
-        return False
+from paddle_tpu.static import nn  # noqa: F401
+from paddle_tpu.static.graph import (Program, program_guard,  # noqa: F401
+                                     default_main_program,
+                                     default_startup_program,
+                                     gradients as _graph_gradients,
+                                     in_static_graph_mode)
+from paddle_tpu.static import graph as _graph
 
 
 class name_scope:
@@ -127,8 +81,12 @@ class device_guard(name_scope):
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    """Placeholder: returns a zero Tensor of the given shape (dims of -1/None
-    become 1), usable to trace shapes eagerly."""
+    """Feed placeholder. In static-graph mode (`paddle.enable_static()`) an
+    abstract Variable registered on the default main program; in dygraph a
+    zero Tensor of the given shape (dims of -1/None become 1), usable to
+    trace shapes eagerly."""
+    if in_static_graph_mode():
+        return _graph.data(name, shape, dtype)
     import paddle_tpu as paddle
 
     shp = [1 if (d is None or int(d) < 0) else int(d) for d in shape]
@@ -167,25 +125,72 @@ class scope_guard:
 
 
 class Executor:
-    """Source-compat Executor (reference `base/executor.py:1734` Executor.run).
-
-    With the eager/XLA substrate there is no ProgramDesc to interpret: `run`
-    on a loaded inference program dispatches to the compiled Predictor."""
+    """Static-program executor (reference `base/executor.py:1734`
+    Executor.run -> `_run_pir_impl`): compiles the recorded Program tape
+    into one jitted feed->fetch function, cached per feed-shape signature
+    (see `paddle_tpu/static/graph.py`). Also runs loaded inference programs
+    through the Predictor and plain callables for source compat."""
 
     def __init__(self, place=None):
         self.place = place
         self._predictor = None
 
     def run(self, program=None, feed=None, fetch_list=None, **kw):
+        import jax.numpy as jnp
+
+        from paddle_tpu.static.graph import Program as _Program
+
         if isinstance(program, _LoadedInferenceProgram):
             return program.run(feed or {})
+        if program is None and in_static_graph_mode():
+            program = default_main_program()
+        if isinstance(program, _Program):
+            if not program.ops and not fetch_list:
+                # startup program: parameters are already eagerly
+                # materialized (the Scope is the param Tensors themselves)
+                return []
+            feed = feed or {}
+            fetch_list = fetch_list or []
+            fetch_refs = []
+            for v in fetch_list:
+                ref = getattr(v, "_st_ref", None)
+                if ref is None:
+                    raise ValueError(
+                        f"fetch target {v!r} is not a Variable of this "
+                        "Program")
+                fetch_refs.append(ref)
+            feed_names = sorted(feed)
+            feed_arrays = [jnp.asarray(np.asarray(feed[n]))
+                           for n in feed_names]
+            train = program.opt is not None
+            key = ("train" if train else "infer",
+                   tuple(feed_names),
+                   tuple((a.shape, str(a.dtype)) for a in feed_arrays),
+                   tuple(fetch_refs))
+            entry = program._run_cache.get(key)
+            if entry is None:
+                entry = program._run_cache[key] = {
+                    "fn": program.compile(feed_names, fetch_refs, train),
+                    "slots": {},
+                }
+            ext_vals = [t._data for t in program.externals]
+            if train:
+                fetches, new_ext, entry["slots"] = entry["fn"](
+                    feed_arrays, ext_vals, entry["slots"])
+                # write updated params back into the shared Tensors (the
+                # Scope write the reference executor does)
+                for t, a in zip(program.externals, new_ext):
+                    t._data = a
+            else:
+                fetches = entry["fn"](feed_arrays, ext_vals)
+            return [np.asarray(f) for f in fetches]
         if callable(program):
             out = program(**(feed or {}))
             return out if isinstance(out, (list, tuple)) else [out]
         raise ValueError(
-            "Executor.run needs a loaded inference program "
-            "(load_inference_model) or a callable; build compiled graphs with "
-            "paddle_tpu.jit.to_static")
+            "Executor.run needs a static Program (enable_static + "
+            "program_guard), a loaded inference program "
+            "(load_inference_model) or a callable")
 
     def close(self):
         pass
@@ -264,7 +269,12 @@ def create_global_var(shape, value, dtype, persistable=False,
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
               name=None):
-    """reference `static/gradients` — maps onto the eager tape."""
+    """reference `static/gradients`: in static-graph mode, new Variables
+    differentiating the recorded tape (compile-time jax.grad); in dygraph,
+    the eager tape."""
+    if in_static_graph_mode():
+        return _graph_gradients(targets, inputs, target_gradients,
+                                no_grad_set, name)
     from paddle_tpu.core.backward import grad as _grad
 
     outs = targets if isinstance(targets, (list, tuple)) else [targets]

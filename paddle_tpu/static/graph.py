@@ -1,0 +1,374 @@
+"""Real static-graph mode: deferred programs over the dispatch waist.
+
+The reference's static mode records ops into a `ProgramDesc` as Python
+builds the graph, then `Executor.run` interprets it
+(`python/paddle/base/framework.py:5890` Program,
+`base/executor.py:1734` Executor.run -> `_run_pir_impl`). The TPU-native
+equivalent keeps the build-record / run-compile split but replaces both
+halves with XLA-shaped machinery:
+
+  build:  `paddle.enable_static()` + `static.data(...)` create Variables —
+          ordinary Tensors whose `_data` is a `jax.ShapeDtypeStruct`. Every
+          op on them hits the dispatch waist, which (instead of executing)
+          calls `jax.eval_shape` for output avals and records
+          (fn, in_refs, n_out) into the active Program. NO flops run at
+          build time, exactly like ProgramDesc building. Layer parameters
+          stay eagerly-initialized real Tensors and are recorded as
+          externals (the Scope role): the program re-reads them at run, so
+          eager code and static programs share parameter storage.
+  run:    `Executor.run(feed=..., fetch_list=...)` compiles the tape into
+          one `jax.jit` function from (feed arrays, externals) to fetches
+          — the PirInterpreter + pass-stack role collapses into XLA — and
+          caches it per feed-shape signature (dynamic batch = one compile
+          per concrete shape, the reference's shape-special executor
+          cache). `optimizer.minimize(loss)` recorded on the program turns
+          the compiled function into a full train step: jax.grad over the
+          trainable externals + a functional optimizer update, with the new
+          parameter values written back into the shared Tensors after each
+          run.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import tensor as _tc
+from paddle_tpu.core.tensor import Tensor
+
+__all__ = ["Program", "program_guard", "default_main_program",
+           "default_startup_program", "data", "enable_static_graph",
+           "disable_static_graph", "in_static_graph_mode", "gradients"]
+
+
+def _is_abstract(x):
+    return isinstance(x, jax.ShapeDtypeStruct)
+
+
+class Program:
+    """Deferred op tape (reference Program/ProgramDesc role)."""
+
+    def __init__(self):
+        self.ops = []          # (fn, refs, first_node, nout, name)
+        self.feeds = {}        # name -> ShapeDtypeStruct (declared aval)
+        self.feed_order = []
+        self.externals = []    # real Tensors read at run (params/consts)
+        self._ext_ids = {}     # id(array) -> ext index
+        self.node_avals = []
+        self._grad_entries = {}  # node id -> ('grad', target_ref, in_refs)
+        self.opt = None        # (optimizer, loss_ref) from minimize()
+        self._run_cache = {}
+        self.random_seed = None
+
+    # -- build-time recording (called from the waist) ----------------------
+    def ref_for(self, t):
+        d = t._data
+        ref = getattr(t, "_st_ref", None)
+        if ref is not None:
+            return ref
+        if _is_abstract(d):
+            raise RuntimeError(
+                "abstract Variable from another Program used here")
+        idx = self._ext_ids.get(id(d))
+        if idx is None:
+            idx = len(self.externals)
+            self.externals.append(t)
+            self._ext_ids[id(d)] = idx
+        return ("ext", idx)
+
+    def record(self, fn, tensors, name):
+        if not any(_is_abstract(t._data) for t in tensors):
+            return None  # concrete subexpression: let eager run it
+        refs = [self.ref_for(t) for t in tensors]
+        out = jax.eval_shape(fn, *[t._data for t in tensors])
+        multi = isinstance(out, (tuple, list))
+        avals = list(out) if multi else [out]
+        base = len(self.node_avals)
+        self.ops.append((fn, refs, base, len(avals), name))
+        outs = []
+        for j, av in enumerate(avals):
+            v = Tensor(av, stop_gradient=True)
+            v._st_ref = ("n", base + j)
+            self.node_avals.append(av)
+            outs.append(v)
+        self._invalidate()
+        return outs if multi else outs[0]
+
+    def add_feed(self, name, shape, dtype):
+        shp = tuple(1 if (s is None or s == -1) else int(s) for s in shape)
+        av = jax.ShapeDtypeStruct(shp, jnp.dtype(dtype))
+        self.feeds[name] = av
+        self.feed_order.append(name)
+        v = Tensor(av, stop_gradient=True, name=name)
+        v._st_ref = ("feed", name)
+        self._invalidate()
+        return v
+
+    def record_minimize(self, optimizer, loss):
+        ref = getattr(loss, "_st_ref", None)
+        if ref is None:
+            raise ValueError("minimize(loss): loss is not part of this "
+                             "static Program")
+        self.opt = (optimizer, ref)
+        self._invalidate()
+
+    def record_gradients(self, targets, inputs):
+        """static.gradients: new Variables holding d(target)/d(input),
+        computed at compile time by differentiating the prefix replay."""
+        tgt = targets[0] if isinstance(targets, (list, tuple)) else targets
+        t_ref = getattr(tgt, "_st_ref", None)
+        if t_ref is None:
+            raise ValueError("gradients(): target is not in this Program")
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        in_refs = [self.ref_for(v) for v in ins]
+        outs = []
+        base = len(self.node_avals)
+        for j, v in enumerate(ins):
+            av = jax.ShapeDtypeStruct(tuple(v._data.shape),
+                                      jnp.dtype(v._data.dtype))
+            g = Tensor(av, stop_gradient=True)
+            g._st_ref = ("n", base + j)
+            self.node_avals.append(av)
+            self._grad_entries[base + j] = (t_ref, in_refs, j)
+            outs.append(g)
+        self.ops.append(("__grad__", in_refs, base, len(ins), "gradients"))
+        self._invalidate()
+        return outs
+
+    def _invalidate(self):
+        self._run_cache.clear()
+
+    # -- compat surface -----------------------------------------------------
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def all_parameters(self):
+        return [t for t in self.externals if not t.stop_gradient]
+
+    @property
+    def blocks(self):
+        return [self]
+
+    # -- run-time compilation ----------------------------------------------
+    def _replay(self, feed_env, ext_vals, upto=None):
+        env = dict(feed_env)
+        for i, a in enumerate(ext_vals):
+            env[("ext", i)] = a
+        n_ops = len(self.ops) if upto is None else upto
+        for fn, refs, base, nout, name in self.ops[:n_ops]:
+            if fn == "__grad__":
+                t_ref, in_refs, _ = self._grad_entries[base]
+                frozen = set(in_refs)
+
+                def h(vals):
+                    env2 = dict(env)
+                    for r, v in zip(in_refs, vals):
+                        env2[r] = v
+                    return self._replay_from(env2, base_limit=base,
+                                             want=t_ref, frozen=frozen)
+
+                grads = jax.grad(lambda vals: h(vals).astype(jnp.float32)
+                                 .sum())([env[r] for r in in_refs])
+                for j in range(nout):
+                    env[("n", base + j)] = grads[j]
+                continue
+            out = fn(*[env[r] for r in refs])
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for j, o in enumerate(outs):
+                env[("n", base + j)] = o
+        return env
+
+    def _replay_from(self, env, base_limit, want, frozen=()):
+        """Re-run the prefix tape; refs in `frozen` are differentiation
+        tracers injected by a __grad__ entry and must NOT be overwritten by
+        their producing ops (downstream consumers read the tracer)."""
+        for fn, refs, base, nout, name in self.ops:
+            if base >= base_limit:
+                break
+            if fn == "__grad__":
+                continue
+            out = fn(*[env[r] for r in refs])
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for j, o in enumerate(outs):
+                ref = ("n", base + j)
+                if ref not in frozen:
+                    env[ref] = o
+        return env[want]
+
+    def compile(self, feed_names, fetch_refs, train):
+        """-> jitted fn(feed_arrays, ext_arrays[, slots]) -> (fetches, ...)"""
+        opt = self.opt
+
+        if not train or opt is None:
+            def run_fn(feed_arrays, ext_vals):
+                env = {("feed", n): a for n, a in
+                       zip(feed_names, feed_arrays)}
+                env = self._replay(env, ext_vals)
+                return [env[r] for r in fetch_refs]
+
+            return jax.jit(run_fn)
+
+        optimizer, loss_ref = opt
+        train_mask = [not t.stop_gradient for t in self.externals]
+
+        def step_fn(feed_arrays, ext_vals, slots):
+            env0 = {("feed", n): a for n, a in zip(feed_names, feed_arrays)}
+
+            def loss_of(train_vals):
+                vals, it = [], iter(train_vals)
+                for a, m in zip(ext_vals, train_mask):
+                    vals.append(next(it) if m else a)
+                env = self._replay(env0, vals)
+                return env[loss_ref].astype(jnp.float32), env
+
+            train_vals = [a for a, m in zip(ext_vals, train_mask) if m]
+            (loss, env), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_vals)
+            new_train, new_slots = _functional_step(
+                optimizer, train_vals, grads, slots)
+            new_ext, it = [], iter(new_train)
+            for a, m in zip(ext_vals, train_mask):
+                new_ext.append(next(it) if m else a)
+            return [env[r] for r in fetch_refs], new_ext, new_slots
+
+        return jax.jit(step_fn)
+
+
+# -- functional optimizer updates (the static-mode optimizer ops the
+# -- reference's minimize() appends to the program) --------------------------
+
+
+def _hyper(opt, *names, default=None):
+    for n in names:
+        v = getattr(opt, n, None)
+        if v is not None:
+            if isinstance(v, Tensor):
+                v = float(np.asarray(v._data))
+            return v
+    return default
+
+
+def _functional_step(opt, params, grads, slots):
+    kind = type(opt).__name__
+    lr = _hyper(opt, "_learning_rate", "learning_rate", default=0.01)
+    if callable(getattr(lr, "get_lr", None)):
+        lr = lr.get_lr()
+    lr = float(lr) if not isinstance(lr, float) else lr
+    if kind in ("SGD",):
+        return ([p - lr * g.astype(p.dtype) for p, g in
+                 zip(params, grads)], slots)
+    if kind in ("Momentum",):
+        mu = _hyper(opt, "_momentum", "momentum", default=0.9)
+        vel = slots.get("velocity") or [jnp.zeros_like(p) for p in params]
+        new_v = [mu * v + g.astype(v.dtype) for v, g in zip(vel, grads)]
+        return ([p - lr * v for p, v in zip(params, new_v)],
+                {**slots, "velocity": new_v})
+    if kind in ("Adam", "AdamW"):
+        b1 = _hyper(opt, "_beta1", "beta1", default=0.9)
+        b2 = _hyper(opt, "_beta2", "beta2", default=0.999)
+        eps = _hyper(opt, "_epsilon", "epsilon", default=1e-8)
+        wd = (_hyper(opt, "_weight_decay", "weight_decay", default=0.01)
+              if kind == "AdamW" else 0.0)
+        if not isinstance(wd, (int, float)):
+            wd = 0.01
+        m = slots.get("m") or [jnp.zeros(p.shape, jnp.float32)
+                               for p in params]
+        v = slots.get("v") or [jnp.zeros(p.shape, jnp.float32)
+                               for p in params]
+        step = slots.get("step", jnp.zeros((), jnp.int32)) + 1
+        b1t = 1.0 - b1 ** step.astype(jnp.float32)
+        b2t = 1.0 - b2 ** step.astype(jnp.float32)
+        new_p, new_m, new_v = [], [], []
+        for p, g, mi, vi in zip(params, grads, m, v):
+            g32 = g.astype(jnp.float32)
+            mi = b1 * mi + (1 - b1) * g32
+            vi = b2 * vi + (1 - b2) * g32 * g32
+            upd = (mi / b1t) / (jnp.sqrt(vi / b2t) + eps)
+            p32 = p.astype(jnp.float32)
+            p32 = p32 - lr * (upd + wd * p32)
+            new_p.append(p32.astype(p.dtype))
+            new_m.append(mi)
+            new_v.append(vi)
+        return new_p, {**slots, "m": new_m, "v": new_v, "step": step}
+    raise NotImplementedError(
+        f"static-mode minimize: optimizer {kind} has no functional update "
+        "rule yet (supported: SGD, Momentum, Adam, AdamW)")
+
+
+# -- mode + default programs -------------------------------------------------
+
+_programs = []  # stack: (main, startup)
+
+
+def _fresh():
+    return (Program(), Program())
+
+
+def _current():
+    if not _programs:
+        _programs.append(_fresh())
+    return _programs[-1]
+
+
+def default_main_program():
+    return _current()[0]
+
+
+def default_startup_program():
+    return _current()[1]
+
+
+class program_guard:
+    def __init__(self, main_program=None, startup_program=None):
+        self.main = main_program or Program()
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        _programs.append((self.main, self.startup))
+        _sync_tape()
+        return self
+
+    def __exit__(self, *exc):
+        _programs.pop()
+        _sync_tape()
+
+
+def enable_static_graph():
+    _tc._static_tape = _Recorder()
+
+
+def disable_static_graph():
+    _tc._static_tape = None
+
+
+def in_static_graph_mode():
+    return _tc._static_tape is not None
+
+
+def _sync_tape():
+    if _tc._static_tape is not None:
+        _tc._static_tape = _Recorder()
+
+
+class _Recorder:
+    """The waist hook object: routes op recording to the CURRENT default
+    main program (so program_guard redirects building)."""
+
+    @staticmethod
+    def record(fn, tensors, name):
+        return default_main_program().record(fn, tensors, name)
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    if not in_static_graph_mode():
+        raise RuntimeError("static.data requires paddle.enable_static()")
+    return default_main_program().add_feed(name, shape, dtype)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    return default_main_program().record_gradients(targets, inputs)

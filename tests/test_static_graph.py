@@ -1,0 +1,210 @@
+"""Static-graph mode: deferred Programs + compiled Executor
+(paddle_tpu/static/graph.py).
+
+Reference behaviours mirrored: `paddle.enable_static()` +
+`static.data`/`program_guard` building a Program without executing
+(`base/framework.py:5890`), `Executor.run(feed, fetch_list)` executing it
+(`base/executor.py:1734`), `optimizer.minimize(loss)` appending the
+backward + update ops, `static.gradients` emitting grad variables, and
+static.nn layer builders (`static/nn/common.py`).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    try:
+        with static.program_guard(static.Program(), static.Program()):
+            yield
+    finally:
+        paddle.disable_static()
+
+
+class TestBuild:
+    def test_ops_record_without_executing(self, static_mode):
+        x = static.data("x", [3, 4], "float32")
+        y = x * 2.0 + 1.0
+        prog = static.default_main_program()
+        assert len(prog.ops) >= 1
+        assert list(y.shape) == [3, 4]
+        with pytest.raises(RuntimeError, match="static-graph Variable"):
+            y.numpy()  # no value exists at build time
+
+    def test_program_guard_isolation(self, static_mode):
+        outer = static.default_main_program()
+        x = static.data("x", [2], "float32")
+        _ = x + 1.0
+        n_outer = len(outer.ops)
+        with static.program_guard(static.Program(), static.Program()):
+            inner = static.default_main_program()
+            assert inner is not outer
+            z = static.data("z", [2], "float32")
+            _ = z * 3.0
+            assert len(inner.ops) >= 1
+        assert len(outer.ops) == n_outer  # inner build didn't leak
+
+    def test_shape_inference_matches_eval_shape(self, static_mode):
+        x = static.data("x", [5, 6], "float32")
+        y = paddle.matmul(x, paddle.ones([6, 7]))
+        assert list(y.shape) == [5, 7]
+        s = paddle.sum(y, axis=0)
+        assert list(s.shape) == [7]
+
+
+class TestExecutor:
+    def test_forward_fetch(self, static_mode):
+        x = static.data("x", [None, 4], "float32")
+        y = (x * 2.0).sum()
+        exe = static.Executor()
+        out, = exe.run(feed={"x": np.ones((3, 4), np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out, 24.0)
+
+    def test_dynamic_batch_recompiles(self, static_mode):
+        x = static.data("x", [None, 2], "float32")
+        y = x.sum()
+        exe = static.Executor()
+        a, = exe.run(feed={"x": np.ones((2, 2), np.float32)}, fetch_list=[y])
+        b, = exe.run(feed={"x": np.ones((5, 2), np.float32)}, fetch_list=[y])
+        np.testing.assert_allclose(a, 4.0)
+        np.testing.assert_allclose(b, 10.0)
+
+    def test_multiple_fetches(self, static_mode):
+        x = static.data("x", [2, 2], "float32")
+        a = x + 1.0
+        b = x * 3.0
+        exe = static.Executor()
+        ra, rb = exe.run(feed={"x": np.zeros((2, 2), np.float32)},
+                         fetch_list=[a, b])
+        np.testing.assert_allclose(ra, np.ones((2, 2)))
+        np.testing.assert_allclose(rb, np.zeros((2, 2)))
+
+    def test_layer_params_are_shared_externals(self, static_mode):
+        lin = paddle.nn.Linear(4, 2)
+        x = static.data("x", [3, 4], "float32")
+        y = lin(x)  # ordinary Layer builds onto the program
+        exe = static.Executor()
+        out, = exe.run(feed={"x": np.ones((3, 4), np.float32)},
+                       fetch_list=[y])
+        expect = (np.ones((3, 4), np.float32) @
+                  np.asarray(lin.weight._data) + np.asarray(lin.bias._data))
+        np.testing.assert_allclose(out, expect, rtol=1e-5)
+        # mutate the parameter eagerly; the compiled program re-reads it
+        lin.weight.set_value(paddle.to_tensor(
+            np.zeros((4, 2), np.float32)))
+        out2, = exe.run(feed={"x": np.ones((3, 4), np.float32)},
+                        fetch_list=[y])
+        np.testing.assert_allclose(
+            out2, np.broadcast_to(np.asarray(lin.bias._data), (3, 2)),
+            rtol=1e-5)
+
+
+class TestTraining:
+    def _build_and_train(self, opt_factory, steps=40):
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(16, 4)).astype(np.float32)
+        ys = xs @ np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+        x = static.data("x", [None, 4], "float32")
+        label = static.data("label", [None, 1], "float32")
+        y = static.nn.fc(x, 1)
+        loss = paddle.mean((y - label) ** 2)
+        opt_factory().minimize(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        losses = [float(exe.run(feed={"x": xs, "label": ys},
+                                fetch_list=[loss])[0])
+                  for _ in range(steps)]
+        return losses
+
+    def test_sgd_minimize_converges(self, static_mode):
+        losses = self._build_and_train(
+            lambda: paddle.optimizer.SGD(learning_rate=0.1), steps=60)
+        assert losses[-1] < losses[0] * 0.05
+
+    def test_adam_minimize_converges(self, static_mode):
+        losses = self._build_and_train(
+            lambda: paddle.optimizer.Adam(learning_rate=0.05))
+        assert losses[-1] < losses[0] * 0.2
+
+    def test_param_updates_visible_in_eager(self, static_mode):
+        lin = paddle.nn.Linear(2, 1)
+        w_before = np.asarray(lin.weight._data).copy()
+        x = static.data("x", [4, 2], "float32")
+        loss = paddle.mean(lin(x) ** 2)
+        paddle.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = static.Executor()
+        exe.run(feed={"x": np.ones((4, 2), np.float32)}, fetch_list=[loss])
+        w_after = np.asarray(lin.weight._data)
+        assert not np.allclose(w_before, w_after)  # scope write-back
+
+    def test_static_matches_eager_sgd_step(self, static_mode):
+        # one SGD step on a fixed linear model: static program == eager math
+        xs = np.ones((4, 3), np.float32)
+        ys = np.full((4, 1), 2.0, np.float32)
+        w0 = np.arange(3, dtype=np.float32).reshape(3, 1) * 0.1
+
+        lin = paddle.nn.Linear(3, 1)
+        lin.weight.set_value(paddle.to_tensor(w0))
+        lin.bias.set_value(paddle.to_tensor(np.zeros(1, np.float32)))
+        x = static.data("x", [4, 3], "float32")
+        label = static.data("label", [4, 1], "float32")
+        loss = paddle.mean((lin(x) - label) ** 2)
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = static.Executor()
+        st_loss, = exe.run(feed={"x": xs, "label": ys}, fetch_list=[loss])
+        st_w = np.asarray(lin.weight._data)
+
+        # eager twin
+        pred = xs @ w0
+        grad_w = xs.T @ (2.0 * (pred - ys) / 4.0)
+        expect_w = w0 - 0.1 * grad_w
+        np.testing.assert_allclose(st_loss, np.mean((pred - ys) ** 2),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(st_w, expect_w, rtol=1e-4)
+
+
+class TestGradients:
+    def test_static_gradients_variable(self, static_mode):
+        x = static.data("x", [3], "float32")
+        y = (x * x).sum()
+        (gx,) = static.gradients([y], [x])
+        exe = static.Executor()
+        arr = np.array([1.0, 2.0, 3.0], np.float32)
+        out, = exe.run(feed={"x": arr}, fetch_list=[gx])
+        np.testing.assert_allclose(out, 2.0 * arr, rtol=1e-6)
+
+
+class TestStaticNN:
+    def test_conv_bn_dropout_stack(self, static_mode):
+        img = static.data("img", [2, 3, 8, 8], "float32")
+        h = static.nn.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                             act="relu")
+        h = static.nn.batch_norm(h, is_test=True)
+        h = static.nn.dropout(h, dropout_prob=0.5, is_test=True)
+        out = static.nn.fc(h, 10)
+        exe = static.Executor()
+        r, = exe.run(feed={"img": np.ones((2, 3, 8, 8), np.float32)},
+                     fetch_list=[out])
+        assert r.shape == (2, 10)
+        assert np.isfinite(r).all()
+
+    def test_layer_norm_prelu(self, static_mode):
+        x = static.data("x", [4, 6], "float32")
+        h = static.nn.layer_norm(x)
+        h = static.nn.prelu(h)
+        exe = static.Executor()
+        r, = exe.run(feed={"x": np.random.default_rng(0).normal(
+            size=(4, 6)).astype(np.float32)}, fetch_list=[h])
+        assert r.shape == (4, 6)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
