@@ -232,10 +232,14 @@ def roll(x, shifts, axis=None, name=None):
 def gather(x, index, axis=0, name=None):
     if isinstance(axis, Tensor):
         axis = int(axis.item())
-    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
-    if idx.ndim > 1:
-        idx = idx.reshape(-1)
-    return apply(lambda a: jnp.take(a, idx, axis=axis), x, _name="gather")
+    it = index if isinstance(index, Tensor) else Tensor(jnp.asarray(index))
+
+    def fn(a, idx):
+        if idx.ndim > 1:
+            idx = idx.reshape(-1)
+        return jnp.take(a, idx, axis=axis)
+
+    return apply(fn, x, it, _name="gather")
 
 
 def gather_nd(x, index, name=None):
@@ -316,13 +320,15 @@ def scatter_nd(index, updates, shape, name=None):
 
 
 def index_select(x, index, axis=0, name=None):
-    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
-    return apply(lambda a: jnp.take(a, idx, axis=axis), x, _name="index_select")
+    it = index if isinstance(index, Tensor) else Tensor(jnp.asarray(index))
+    return apply(lambda a, idx: jnp.take(a, idx, axis=axis), x, it,
+                 _name="index_select")
 
 
 def index_sample(x, index):
-    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
-    return apply(lambda a: jnp.take_along_axis(a, idx, axis=1), x, _name="index_sample")
+    it = index if isinstance(index, Tensor) else Tensor(jnp.asarray(index))
+    return apply(lambda a, idx: jnp.take_along_axis(a, idx, axis=1), x, it,
+                 _name="index_sample")
 
 
 def index_add(x, index, axis, value, name=None):

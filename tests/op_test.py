@@ -24,6 +24,27 @@ class OpTest:
     fd_eps = 1e-3
     n_probe = 6  # finite-difference coordinates probed per input
 
+    def _check_static(self, fn, expect, inputs, rtol, atol, name):
+        import paddle_tpu.static as static
+
+        paddle.enable_static()
+        try:
+            with static.program_guard(static.Program(), static.Program()):
+                vars_ = [static.data(f"in{i}", list(np.asarray(a).shape)
+                                     or [1], str(np.asarray(a).dtype))
+                         for i, a in enumerate(inputs)]
+                out_v = fn(*vars_)
+                exe = static.Executor()
+                feed = {f"in{i}": np.asarray(a).reshape(
+                    np.asarray(a).shape or (1,))
+                    for i, a in enumerate(inputs)}
+                got, = exe.run(feed=feed, fetch_list=[out_v])
+        finally:
+            paddle.disable_static()
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(np.asarray(expect).shape), expect,
+            rtol=rtol, atol=atol, err_msg=f"{name}: static vs numpy")
+
     def check(self, fn, np_ref, inputs, grad=True, grad_inputs=None,
               rtol=None, atol=None, name=""):
         """fn: paddle op over Tensors; np_ref: same math over np arrays;
@@ -45,6 +66,11 @@ class OpTest:
         np.testing.assert_allclose(np.asarray(jitted(*inputs)), expect,
                                    rtol=rtol, atol=atol,
                                    err_msg=f"{name}: jit vs numpy")
+
+        # STATIC-graph parity (reference OpTest runs every op in dygraph AND
+        # static+PIR modes, op_test.py:418): build a deferred Program with
+        # the op over static.data placeholders, run through Executor
+        self._check_static(fn, expect, inputs, rtol, atol, name)
 
         if not grad:
             return
