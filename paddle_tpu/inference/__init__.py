@@ -131,9 +131,59 @@ class Config:
         self._noop_warn("enable_tensorrt_engine",
                         "XLA fusion replaces TRT subgraphs on TPU")
 
+    # -- analysis passes (reference AnalysisConfig::pass_builder,
+    #    `api/paddle_pass_builder.cc`): a REAL pipeline run at Predictor
+    #    build time. Passes the XLA compiler subsumes (fusion, constant
+    #    folding, layout) are listed as built-ins and cannot be deleted —
+    #    deleting them warns instead of silently diverging. ----------------
+    def pass_builder(self):
+        if not hasattr(self, "_pass_strategy"):
+            self._pass_strategy = PassStrategy()
+        return self._pass_strategy
+
+    def delete_pass(self, name):
+        self.pass_builder().delete_pass(name)
+
     def summary(self):
         return (f"Config(prog={self.prog_file}, params={self.params_file}, "
-                f"device={self._device})")
+                f"device={self._device}, "
+                f"passes={self.pass_builder().all_passes()})")
+
+
+class PassStrategy:
+    """reference `PaddlePassBuilder` (`api/paddle_pass_builder.h`): an
+    ordered, editable pass list. Load-time passes here operate on the
+    deserialized export + parameter state; compile-time optimization is
+    XLA's pass pipeline (the built-in entries)."""
+
+    _BUILTIN = ("xla_fusion", "xla_constant_folding", "xla_layout_assignment")
+    _DEFAULT = ("weight_dedup_pass",)
+    _AVAILABLE = ("weight_dedup_pass", "bf16_weights_pass")
+
+    def __init__(self):
+        self._passes = list(self._DEFAULT)
+
+    def all_passes(self):
+        return list(self._BUILTIN) + list(self._passes)
+
+    def delete_pass(self, name):
+        if name in self._passes:
+            self._passes.remove(name)
+        elif name in self._BUILTIN:
+            import warnings
+
+            warnings.warn(f"pass {name!r} is part of the XLA compile "
+                          "pipeline and cannot be deleted", stacklevel=2)
+
+    def append_pass(self, name):
+        if name not in self._AVAILABLE:
+            raise ValueError(
+                f"unknown pass {name!r}; available: {self._AVAILABLE}")
+        if name not in self._passes:
+            self._passes.append(name)
+
+    def insert_pass(self, idx, name):
+        self.append_pass(name)
 
 
 class PredictorHandle:
@@ -184,7 +234,16 @@ class Predictor:
             dev = jax.devices("cpu")[0]
         else:
             dev = jax.devices()[config._device_id]
-        self._params = [jax.device_put(state[k], dev) for k in self._param_keys]
+        params = [state[k] for k in self._param_keys]
+        params = self._apply_passes(config, params)
+        placed = {}
+        self._params = []
+        for a in params:
+            # aliased (deduped) weights device_put once and share buffers
+            key = id(a)
+            if key not in placed:
+                placed[key] = jax.device_put(a, dev)
+            self._params.append(placed[key])
         self._inputs = {n: PredictorHandle(n) for n in self._input_names}
         self._outputs = {n: PredictorHandle(n) for n in self._output_names}
         # deploy dtypes per input (the export is dtype-exact; the handle
@@ -193,6 +252,45 @@ class Predictor:
         self._input_dtypes = [
             a.dtype for a in self._exported.in_avals[-len(self._input_names):]
         ] if self._input_names else []
+
+    def _apply_passes(self, config, params):
+        """Run the load-time analysis passes (reference
+        `AnalysisPredictor::OptimizeInferenceProgram`,
+        `analysis_predictor.cc`)."""
+        names = config.pass_builder()._passes
+        if "weight_dedup_pass" in names:
+            # alias byte-identical weights (tied embeddings exported twice):
+            # one host copy -> one device buffer. Group by (shape, dtype)
+            # first so singletons never pay the content hash.
+            from collections import defaultdict
+
+            groups = defaultdict(list)
+            for i, a in enumerate(params):
+                arr = np.asarray(a)
+                groups[(arr.shape, str(arr.dtype))].append(i)
+            for idxs in groups.values():
+                if len(idxs) < 2:
+                    continue
+                seen = {}
+                for i in idxs:
+                    arr = np.asarray(params[i])
+                    h = hash(arr.tobytes())
+                    j = seen.get(h)
+                    if j is not None and np.array_equal(
+                            np.asarray(params[j]), arr):
+                        params[i] = params[j]
+                    else:
+                        seen[h] = i
+        # bf16_weights_pass: halve parameter HBM; run() casts back to the
+        # export dtype on the fly (a transient f32 view per call)
+        self._cast_params = "bf16_weights_pass" in names
+        if self._cast_params:
+            import jax.numpy as jnp
+
+            params = [np.asarray(a).astype(jnp.bfloat16)
+                      if np.asarray(a).dtype == np.float32 else a
+                      for a in params]
+        return params
 
     def get_input_names(self):
         return list(self._input_names)
@@ -207,7 +305,12 @@ class Predictor:
         return self._outputs[name]
 
     def run(self, inputs=None):
-        """AnalysisPredictor::Run / ZeroCopyRun (`analysis_predictor.cc:1574,2577`)."""
+        """AnalysisPredictor::Run / ZeroCopyRun (`analysis_predictor.cc:1574,2577`).
+
+        Outputs stay on device (jax arrays, asynchronously dispatched) —
+        the ZeroCopy contract: the host transfer happens when the caller
+        reads them (np.asarray / handle.copy_to_cpu), so back-to-back
+        run() calls pipeline instead of syncing per step."""
         if inputs is not None:  # positional list form
             for h, arr in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(np.asarray(arr))
@@ -215,8 +318,12 @@ class Predictor:
 
         feeds = [jnp.asarray(self._inputs[n]._array, dtype=dt)
                  for n, dt in zip(self._input_names, self._input_dtypes)]
-        args = self._params + feeds
-        out = self._exported.call(*args)
+        params = self._params
+        if getattr(self, "_cast_params", False):
+            navals = self._exported.in_avals[:len(params)]
+            params = [p.astype(av.dtype) if p.dtype != av.dtype else p
+                      for p, av in zip(params, navals)]
+        out = self._exported.call(*params, *feeds)
         outs = list(out) if isinstance(out, (list, tuple)) else [out]
         if len(outs) != len(self._output_names):
             # older saves lacked output_names; never drop outputs
@@ -224,9 +331,8 @@ class Predictor:
             self._outputs = {n: PredictorHandle(n) for n in self._output_names}
         results = []
         for name, o in zip(self._output_names, outs):
-            a = np.asarray(o)
-            self._outputs[name]._array = a
-            results.append(a)
+            self._outputs[name]._array = o
+            results.append(o)
         return results
 
 

@@ -1444,3 +1444,87 @@ def test_tensor_method_surface_parity():
     z.set_()
     z.resize_([2, 2])
     assert z.numpy().tolist() == [[0.0, 0.0], [0.0, 0.0]]
+
+
+def test_inference_pass_framework(tmp_path):
+    """Analysis passes (reference AnalysisConfig::pass_builder,
+    `api/paddle_pass_builder.cc`): editable pass list; weight_dedup aliases
+    byte-identical weights to ONE device buffer; bf16_weights_pass halves
+    parameter HBM with an on-the-fly cast back at run; deleting an
+    XLA-built-in pass warns instead of lying."""
+    import warnings
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    class Tied(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(8, 8, bias_attr=False)
+            self.b = nn.Linear(8, 8, bias_attr=False)
+            self.b.weight.set_value(self.a.weight)  # byte-identical
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    m = Tied()
+    prefix = str(tmp_path / "tied")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([2, 8], "float32", "x")])
+
+    cfg = Config(prefix)
+    assert "weight_dedup_pass" in cfg.pass_builder().all_passes()
+    assert "xla_fusion" in cfg.pass_builder().all_passes()
+    pred = create_predictor(cfg)
+    bufs = {id(p) for p in pred._params}
+    assert len(bufs) < len(pred._params)  # tied weights share one buffer
+    x = np.ones((2, 8), np.float32)
+    base = np.asarray(pred.run([x])[0])
+
+    # deleting the dedup pass -> distinct buffers, same numerics
+    cfg2 = Config(prefix)
+    cfg2.delete_pass("weight_dedup_pass")
+    pred2 = create_predictor(cfg2)
+    assert len({id(p) for p in pred2._params}) == len(pred2._params)
+    np.testing.assert_allclose(np.asarray(pred2.run([x])[0]), base,
+                               rtol=1e-6)
+
+    # bf16 weights: storage halves, results close to f32
+    cfg3 = Config(prefix)
+    cfg3.pass_builder().append_pass("bf16_weights_pass")
+    pred3 = create_predictor(cfg3)
+    assert all(str(p.dtype) == "bfloat16" for p in pred3._params)
+    np.testing.assert_allclose(np.asarray(pred3.run([x])[0]), base,
+                               rtol=3e-2, atol=3e-2)
+
+    # built-in XLA passes refuse deletion loudly
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        cfg3.delete_pass("xla_fusion")
+    assert any("cannot be deleted" in str(x.message) for x in w)
+
+    with pytest.raises(ValueError):
+        cfg3.pass_builder().append_pass("nonexistent_pass")
+
+
+def test_predictor_outputs_are_lazy_zero_copy(tmp_path):
+    """run() must not force a host sync: outputs stay device arrays until
+    read (the reference ZeroCopyTensor contract)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    m = nn.Linear(4, 4)
+    prefix = str(tmp_path / "lin")
+    paddle.jit.save(m, prefix, input_spec=[InputSpec([2, 4], "float32", "x")])
+    pred = create_predictor(Config(prefix))
+    out = pred.run([np.ones((2, 4), np.float32)])[0]
+    import jax
+
+    assert isinstance(out, jax.Array)  # not yet materialized to host
+    h = pred.get_output_handle(pred.get_output_names()[0])
+    host = h.copy_to_cpu()
+    assert isinstance(host, np.ndarray)
+    np.testing.assert_allclose(host, np.asarray(out))
