@@ -8,6 +8,8 @@ from paddle_tpu.distributed.api import (  # noqa: F401
 )
 from paddle_tpu.distributed.auto_parallel.strategy import Strategy  # noqa: F401
 from paddle_tpu.distributed.auto_parallel import static  # noqa: F401
+from paddle_tpu.distributed.auto_parallel import tuner  # noqa: F401
+from paddle_tpu.distributed.auto_parallel.tuner import tune  # noqa: F401
 
 __all__ = ["shard_tensor", "reshard", "shard_layer", "dtensor_from_fn",
            "Strategy", "static"]

@@ -118,10 +118,13 @@ class Engine:
         # XLA-subsumed — never silently dropped (VERDICT r4 item 4)
         if st.tuning.enable:
             raise NotImplementedError(
-                "Strategy.tuning (the reference OptimizationTuner/"
-                "parallel_tuner, static/tuner/optimization_tuner.py:193) is "
-                "not implemented; choose dp/mp/sharding degrees explicitly "
-                "or sweep configs with tools/perf_sweep.py")
+                "Strategy.tuning on the static Engine is not wired to a "
+                "model-shape extractor; use the analytic plan tuner "
+                "directly — paddle.distributed.auto_parallel.tuner.tune("
+                "ModelDims(...), n_devices, batch) returns ranked plans "
+                "whose .engine_kwargs() feed HybridParallelEngine (the "
+                "reference OptimizationTuner/parallel_tuner role, "
+                "static/tuner/optimization_tuner.py:193)")
         if st.fused_passes.enable:
             warnings.warn(
                 "Strategy.fused_passes is subsumed on this backend: XLA "

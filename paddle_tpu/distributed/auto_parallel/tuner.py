@@ -1,0 +1,221 @@
+"""Parallel-plan tuner: analytic cost + memory model over the mesh space.
+
+Reference: `python/paddle/distributed/auto_parallel/static/tuner/
+optimization_tuner.py:193` (OptimizationTuner) and `static/tuner/
+parallel_tuner.py` search parallelization configs with a comm/computation
+cost model (`static/cost/`). The TPU-native version is the scaling-book
+recipe as code: enumerate (dp, mp, pp, micro_batches, remat, zero_stage)
+plans for a transformer, score each with
+
+  time   = matmul flops / MXU peak
+         + TP collective bytes / ICI bandwidth      (2 all-gather-ish ops
+           per layer on the activations, fwd+bwd, (mp-1)/mp wire factor)
+         + DP gradient all-reduce bytes / ICI bandwidth
+         + optimizer HBM traffic / HBM bandwidth
+  then   x 1/(1 - bubble): 1F1B bubble (pp-1)/(M+pp-1)
+  memory = param + grad + moment shards (ZeRO shards moments over dp;
+           stage 3 also shards params/grads) + per-microbatch activations
+           scaled by the remat policy's keep-fraction x in-flight stages
+
+and return plans sorted by predicted step time with infeasible (OOM)
+plans filtered. The model's constants are validated in
+tests/test_tuner.py against the r5 hardware sweep on TPU v5e (no-remat
+fits at micro-batch 4 rows but OOMs at 8 with f32 moments, etc.).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+__all__ = ["ModelDims", "ChipSpec", "Plan", "tune", "CHIPS"]
+
+
+@dataclass(frozen=True)
+class ModelDims:
+    """Transformer shape (the subset of config the cost model needs)."""
+
+    hidden: int
+    layers: int
+    intermediate: int
+    vocab: int
+    seq: int
+    heads: int = 0
+    param_bytes: int = 2          # bf16 weights
+    moment_bytes: int = 8         # 2 x f32 AdamW moments / param
+    act_bytes: int = 2
+
+    @property
+    def params(self):
+        h, i = self.hidden, self.intermediate
+        per_layer = 4 * h * h + 3 * h * i + 2 * h
+        return self.vocab * h * 2 + self.layers * per_layer + h
+
+    @property
+    def flops_per_token(self):
+        # fwd+bwd matmul flops (the 6N rule) + causal attention
+        return 6 * self.params + 6 * self.layers * self.hidden * self.seq
+
+    def act_bytes_per_token_layer(self, remat):
+        """Activation bytes/token/layer AD must keep, by remat policy."""
+        h, i = self.hidden, self.intermediate
+        full = (4 * h + 2 * i + 2 * h) * self.act_bytes  # q/k/v/attn-out,
+        #                                                  gate/up, 2 norms
+        keep = {False: 1.0, "lean": 0.55, "dots": 0.45,
+                "half": 0.5, True: 0.1, "full": 0.1}[remat]
+        return full * keep
+
+    def recompute_factor(self, remat):
+        """Extra fwd-compute fraction the backward pays under remat."""
+        return {False: 0.0, "lean": 0.05, "dots": 0.12, "half": 0.17,
+                True: 0.33, "full": 0.33}[remat]
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    name: str
+    peak_flops: float        # dense bf16 FLOP/s
+    hbm_bytes: float
+    hbm_bw: float            # bytes/s
+    ici_bw: float            # bytes/s per link direction
+    mxu_eff: float = 0.72    # achievable fraction of peak on real layers
+
+
+CHIPS = {
+    "v5e": ChipSpec("v5e", 197e12, 16e9, 0.8e12, 0.4e11),
+    "v5p": ChipSpec("v5p", 459e12, 95e9, 2.77e12, 1.2e11),
+    "v4": ChipSpec("v4", 275e12, 32e9, 1.2e12, 0.6e11),
+    "v6e": ChipSpec("v6e", 918e12, 32e9, 1.6e12, 0.9e11),
+}
+
+_REMATS = (False, "lean", "dots", "half", True)
+
+
+@dataclass
+class Plan:
+    dp: int
+    mp: int
+    pp: int
+    micro_batches: int
+    remat: object
+    zero_stage: int
+    sp: bool
+    step_time_s: float
+    mem_bytes: float
+    breakdown: dict = field(default_factory=dict)
+
+    @property
+    def degrees(self):
+        return self.dp * self.mp * self.pp
+
+    def engine_kwargs(self):
+        """Feed straight into HybridParallelEngine(**kwargs)."""
+        return dict(dp=self.dp, mp=self.mp, pp=self.pp,
+                    micro_batches=self.micro_batches, remat=self.remat,
+                    zero_stage=self.zero_stage, sp=self.sp)
+
+    def __repr__(self):
+        return (f"Plan(dp={self.dp} mp={self.mp} pp={self.pp} "
+                f"M={self.micro_batches} remat={self.remat!r} "
+                f"zero={self.zero_stage} sp={self.sp} "
+                f"t={self.step_time_s*1e3:.1f}ms "
+                f"mem={self.mem_bytes/1e9:.1f}GB)")
+
+
+def _factorizations(n):
+    out = []
+    for dp in range(1, n + 1):
+        if n % dp:
+            continue
+        rest = n // dp
+        for mp in range(1, rest + 1):
+            if rest % mp:
+                continue
+            out.append((dp, mp, rest // mp))
+    return out
+
+
+def _score(dims, chip, batch, dp, mp, pp, M, remat, zero, sp):
+    if batch % (dp * M):
+        return None
+    if dims.layers % pp or dims.heads and dims.heads % mp:
+        return None
+    mb = batch // dp // M                      # per-device micro-batch rows
+    if mb == 0:
+        return None
+    tokens_local = batch // dp * dims.seq      # tokens this dp shard owns
+    p_shard = dims.params / (mp * pp)
+    p_bytes = p_shard * dims.param_bytes
+    g_bytes = p_shard * dims.param_bytes
+    if zero >= 3:
+        p_bytes /= dp
+        g_bytes /= dp
+    m_bytes = p_shard * dims.moment_bytes / dp  # ZeRO-1+ shards moments
+    # activations: per-microbatch acts on this chip's layer slice; 1F1B
+    # keeps up to min(M, pp) micro-batches in flight per stage
+    act = (dims.act_bytes_per_token_layer(remat) * mb * dims.seq
+           * (dims.layers / pp) * min(M, pp))
+    # logits / loss-chunk head buffer (chunked CE keeps it ~2 x chunk)
+    head = 2 * mb * dims.seq * dims.hidden * dims.act_bytes
+    mem = p_bytes + g_bytes + m_bytes + act + head
+    mem *= 1.05  # XLA temps/fragmentation margin (calibrated: the r5 v5e
+    #              sweep's fit/OOM boundary for no-remat M=1 vs M=2)
+    if mem > chip.hbm_bytes * 0.97:
+        return None
+
+    flops = (dims.flops_per_token * (1 + dims.recompute_factor(remat))
+             * tokens_local / (mp * pp))
+    t_compute = flops / (chip.peak_flops * chip.mxu_eff)
+    # TP: 2 collectives/layer over [mb*seq, hidden] acts, fwd+bwd(x2)
+    t_tp = 0.0
+    if mp > 1:
+        bytes_tp = (4 * (dims.layers / pp) * M * mb * dims.seq * dims.hidden
+                    * dims.act_bytes * (mp - 1) / mp)
+        if sp:
+            bytes_tp *= 0.75   # reduce-scatter/all-gather vs all-reduce
+        t_tp = bytes_tp / chip.ici_bw
+    # DP grad sync (reduce-scatter + all-gather == 2 x (dp-1)/dp)
+    t_dp = 0.0
+    if dp > 1:
+        t_dp = 2 * g_bytes * (dp - 1) / dp / chip.ici_bw
+    # PP activation sends: M boundary tensors each way
+    t_pp = 0.0
+    if pp > 1:
+        t_pp = (2 * M * mb * dims.seq * dims.hidden * dims.act_bytes
+                * 2 / chip.ici_bw)
+    # optimizer update HBM traffic
+    t_opt = (p_shard * (dims.param_bytes * 2 + dims.moment_bytes * 2)
+             / dp ** (1 if zero >= 1 else 0)) / chip.hbm_bw
+    t = t_compute + t_tp + t_dp + t_pp + t_opt
+    if pp > 1:
+        bubble = (pp - 1) / (M + pp - 1)
+        t = t / (1 - bubble)
+    return Plan(dp, mp, pp, M, remat, zero, sp, t, mem, {
+        "compute": t_compute, "tp": t_tp, "dp": t_dp, "pp": t_pp,
+        "opt": t_opt})
+
+
+def tune(dims: ModelDims, n_devices: int, batch: int, chip="v5e",
+         max_micro=32, zero_stages=(1, 3), top_k=8):
+    """Enumerate + score plans; returns the top_k feasible Plans sorted by
+    predicted step time (the OptimizationTuner role, analytic instead of
+    trial-running).
+
+    dims: ModelDims; batch: GLOBAL batch rows; chip: name in CHIPS or a
+    ChipSpec."""
+    chip = CHIPS[chip] if isinstance(chip, str) else chip
+    plans = []
+    for dp, mp, pp in _factorizations(n_devices):
+        M_cands = {1, pp, 2 * pp, 4 * pp}
+        for M in sorted(M_cands):
+            if M < 1 or M > max_micro:
+                continue
+            for remat in _REMATS:
+                for zero in zero_stages:
+                    for sp in ((False, True) if mp > 1 else (False,)):
+                        p = _score(dims, chip, batch, dp, mp, pp, M,
+                                   remat, zero, sp)
+                        if p is not None:
+                            plans.append(p)
+    plans.sort(key=lambda p: p.step_time_s)
+    return plans[:top_k]
