@@ -114,7 +114,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                               _NEG_INF)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q=256, block_k=512,
+def _flash_fwd(q, k, v, causal, sm_scale, block_q=512, block_k=1024,
                interpret=False):
     """q: [B, H, Sq, D]; k/v: [B, Hk, Sk, D] -> (out [B, H, Sq, D],
     lse [B, H, Sq, 1] f32). Seq lengths must be multiples of 128."""
@@ -258,8 +258,8 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dv_ref[0, 0] += dv
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q=256,
-               block_k=512, interpret=False, g_lse=None):
+def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q=512,
+               block_k=1024, interpret=False, g_lse=None):
     """All operands in [B, H(:k), S, D]; returns (dq, dk, dv) with dk/dv in
     f32 (caller casts). g_lse [B, H, Sq, 1]: cotangent of the logsumexp
     output (ring attention's merge differentiates through lse); folding it
