@@ -1,0 +1,205 @@
+"""Compiled autoregressive generation: one XLA program for the whole decode.
+
+The reference decodes eagerly — each step re-dispatches every op with a
+grown cache (`LlamaForCausalLM.generate`-style loops; cache plumbing in
+`paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu` and
+`incubate/nn/functional/masked_multihead_attention`). On TPU, dynamic
+shapes force a recompile per length, so the TPU-native design is the
+static-shape serving loop:
+
+  - the KV cache is ONE fixed buffer [L, B, max_len, Hkv, D] written with
+    `dynamic_update_slice` at the current position;
+  - attention masks invalid cache slots (iota > pos) instead of slicing a
+    dynamic length — every step has identical shapes;
+  - the entire decode (prefill + lax.scan over steps + greedy/temperature/
+    top-p sampling) traces into ONE `jax.jit`, so a 128-token generation
+    is one device program launch, not 128 Python round-trips.
+
+Works over the pure-functional param tree (`llama_functional`);
+`params_from_layer` bridges a trained eager `LlamaForCausalLM` into it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models import llama_functional as lf
+
+__all__ = ["generate", "params_from_layer", "prefill", "decode_step"]
+
+
+def params_from_layer(model):
+    """Stack an eager `LlamaForCausalLM`/`LlamaModel`'s weights into the
+    functional tree `llama_functional` uses (layers stacked on a leading
+    [L] dim). The transpose conventions match lf.init_params: every weight
+    is [in, out]."""
+    core = getattr(model, "model", model)
+    lm_head = getattr(model, "lm_head", None)
+
+    def arr(t):
+        return t._data if hasattr(t, "_data") else jnp.asarray(t)
+
+    layers = core.layers
+    stacked = {}
+    names = [("wq", lambda l: arr(l.self_attn.q_proj.weight)),
+             ("wk", lambda l: arr(l.self_attn.k_proj.weight)),
+             ("wv", lambda l: arr(l.self_attn.v_proj.weight)),
+             ("wo", lambda l: arr(l.self_attn.o_proj.weight)),
+             ("w_gate", lambda l: arr(l.mlp.gate_proj.weight)),
+             ("w_up", lambda l: arr(l.mlp.up_proj.weight)),
+             ("w_down", lambda l: arr(l.mlp.down_proj.weight)),
+             ("ln1", lambda l: arr(l.input_layernorm.weight)),
+             ("ln2", lambda l: arr(l.post_attention_layernorm.weight))]
+    for key, get in names:
+        stacked[key] = jnp.stack([get(l) for l in layers])
+    return {
+        "embedding": arr(core.embed_tokens.weight),
+        "layers": stacked,
+        "final_norm": arr(core.norm.weight),
+        "lm_head": (arr(lm_head.weight) if lm_head is not None
+                    else arr(core.embed_tokens.weight).T),
+    }
+
+
+def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args, prefill_len):
+    """One decoder layer over `h` [b, s, hid] with a fixed-size cache.
+
+    prefill mode (s == prefill_len, pos == 0): causal attention within the
+    block, cache slots [0, s) written. decode mode (s == 1): attend over
+    cache[: pos+1] via masking, slot [pos] written."""
+    b, s = h.shape[0], h.shape[1]
+    nh = args.num_heads
+    nkv = args.num_kv_heads
+    hd = args.hidden_size // nh
+
+    hin = lf.rms_norm(h, lp["ln1"], args.rms_eps)
+    q = (hin @ lp["wq"]).reshape(b, s, nh, hd)
+    k = (hin @ lp["wk"]).reshape(b, s, nkv, hd)
+    v = (hin @ lp["wv"]).reshape(b, s, nkv, hd)
+    q, k = lf.apply_rope(q, k, jax.lax.dynamic_slice_in_dim(cos, pos, s, 0),
+                         jax.lax.dynamic_slice_in_dim(sin, pos, s, 0))
+    cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, k, pos, axis=1)
+    cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, v, pos, axis=1)
+
+    max_len = cache_k.shape[1]
+    if nkv != nh:
+        rep = nh // nkv
+        kk = jnp.repeat(cache_k, rep, axis=2)
+        vv = jnp.repeat(cache_v, rep, axis=2)
+    else:
+        kk, vv = cache_k, cache_v
+    # [b, heads, s, max_len] scores over the whole cache buffer; invalid
+    # slots masked by position — static shapes every step
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(kk, 1, 2)
+    vh = jnp.swapaxes(vv, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(hd)
+    key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len), 3)
+    query_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len),
+                                               2)
+    scores = jnp.where(key_pos <= query_pos, scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vh.dtype), vh)
+    attn = jnp.swapaxes(attn, 1, 2).reshape(b, s, nh * hd)
+    h = h + attn @ lp["wo"]
+
+    hin = lf.rms_norm(h, lp["ln2"], args.rms_eps)
+    act = jax.nn.silu(hin @ lp["w_gate"]) * (hin @ lp["w_up"])
+    h = h + act @ lp["w_down"]
+    return h, cache_k, cache_v
+
+
+def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args,
+                    prefill_len):
+    """ids [b, s] -> (next-token logits [b, vocab], new caches)."""
+    h = jnp.take(params["embedding"], ids, axis=0)
+
+    def step(carry, xs):
+        h = carry
+        lp, ck, cv = xs
+        h, ck, cv = _layer_step(lp, h, ck, cv, pos, cos, sin, args,
+                                prefill_len)
+        return h, (ck, cv)
+
+    h, (new_k, new_v) = jax.lax.scan(step, h,
+                                     (params["layers"], caches_k, caches_v))
+    h = lf.rms_norm(h, params["final_norm"], args.rms_eps)
+    logits = h[:, -1, :] @ params["lm_head"]
+    return logits.astype(jnp.float32), new_k, new_v
+
+
+def _sample(logits, temperature, top_p, key):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_p < 1.0:
+        # nucleus: mask tokens outside the smallest top-p probability mass
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits >= cutoff, logits, -1e30)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def prefill(params, args, prompt_ids, max_len):
+    """Run the prompt through the model once, filling the caches.
+    Returns (next_logits [b, vocab], caches_k, caches_v)."""
+    L = lf.stack_leading_dim(params["layers"])
+    b, s = prompt_ids.shape
+    hd = args.hidden_size // args.num_heads
+    ck = jnp.zeros((L, b, max_len, args.num_kv_heads, hd),
+                   params["embedding"].dtype)
+    cv = jnp.zeros_like(ck)
+    cos, sin = lf.rope_tables(max_len, hd, args.rope_theta)
+    return _forward_cached(params, prompt_ids, ck, cv, 0, cos, sin, args, s)
+
+
+def decode_step(params, args, token, caches_k, caches_v, pos, max_len):
+    """One incremental step: token [b] at position pos."""
+    hd = args.hidden_size // args.num_heads
+    cos, sin = lf.rope_tables(max_len, hd, args.rope_theta)
+    return _forward_cached(params, token[:, None], caches_k, caches_v, pos,
+                           cos, sin, args, 1)
+
+
+@functools.partial(jax.jit, static_argnames=("args", "max_new_tokens",
+                                             "temperature", "top_p"))
+def generate(params, args, prompt_ids, max_new_tokens=32, temperature=0.0,
+             top_p=1.0, key=None):
+    """Whole generation as one compiled program.
+
+    prompt_ids: [b, s] int32. Returns [b, s + max_new_tokens] int32.
+    temperature 0 = greedy; top_p < 1 = nucleus sampling (needs key)."""
+    if key is None:
+        key = jax.random.key(0)
+    b, s = prompt_ids.shape
+    max_len = s + max_new_tokens
+    hd = args.hidden_size // args.num_heads
+    cos, sin = lf.rope_tables(max_len, hd, args.rope_theta)
+
+    logits, ck, cv = prefill(params, args, prompt_ids, max_len)
+    key, sub = jax.random.split(key)
+    first = _sample(logits, temperature, top_p, sub)
+    if max_new_tokens == 1:
+        return jnp.concatenate([prompt_ids, first[:, None]], axis=1)
+
+    def step(carry, xs):
+        token, ck, cv, pos, key = carry
+        logits, ck, cv = _forward_cached(params, token[:, None], ck, cv, pos,
+                                         cos, sin, args, 1)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, temperature, top_p, sub)
+        return (nxt, ck, cv, pos + 1, key), token
+
+    (last, *_), toks = jax.lax.scan(
+        step, (first, ck, cv, jnp.int32(s), key), None,
+        length=max_new_tokens - 1)
+    new_tokens = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]],
+                                 axis=1)
+    return jnp.concatenate([prompt_ids, new_tokens], axis=1)

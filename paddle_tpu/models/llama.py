@@ -384,8 +384,29 @@ class LlamaForCausalLM(nn.Layer):
         return logits
 
     @paddle.no_grad()
-    def generate(self, input_ids, max_new_tokens=32, temperature=0.0):
-        """Greedy/temperature decode with KV cache."""
+    def generate(self, input_ids, max_new_tokens=32, temperature=0.0,
+                 compiled=False, top_p=1.0, seed=0):
+        """Greedy/temperature decode with KV cache.
+
+        compiled=True runs the whole decode as ONE jitted program with a
+        fixed-size cache (models/generation.py) — the TPU serving path; the
+        default eager loop re-dispatches per step (debuggable, any shape).
+        Greedy outputs are parity-tested identical between the two."""
+        if compiled:
+            import jax
+
+            from paddle_tpu.models import generation as gen
+            from paddle_tpu.models import llama_functional as lf
+
+            params = gen.params_from_layer(self)
+            args = lf.LlamaArgs.from_config(self.config)
+            ids = input_ids.numpy() if hasattr(input_ids, "numpy") \
+                else input_ids
+            out = gen.generate(params, args, ids,
+                               max_new_tokens=max_new_tokens,
+                               temperature=temperature, top_p=top_p,
+                               key=jax.random.key(seed))
+            return paddle.to_tensor(out)
         tokens = input_ids
         past = None
         cur = tokens
