@@ -65,12 +65,13 @@ def params_from_layer(model):
     }
 
 
-def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args, prefill_len):
+def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
     """One decoder layer over `h` [b, s, hid] with a fixed-size cache.
 
-    prefill mode (s == prefill_len, pos == 0): causal attention within the
-    block, cache slots [0, s) written. decode mode (s == 1): attend over
-    cache[: pos+1] via masking, slot [pos] written."""
+    prefill (pos == 0, s == prompt len): causal attention within the
+    block, cache slots [0, s) written. decode (s == 1): attend over
+    cache[: pos+1] via masking, slot [pos] written. Both are the same
+    masking rule: key_pos <= pos + query_row."""
     b, s = h.shape[0], h.shape[1]
     nh = args.num_heads
     nkv = args.num_kv_heads
@@ -113,16 +114,14 @@ def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args, prefill_len):
     return h, cache_k, cache_v
 
 
-def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args,
-                    prefill_len):
+def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args):
     """ids [b, s] -> (next-token logits [b, vocab], new caches)."""
     h = jnp.take(params["embedding"], ids, axis=0)
 
     def step(carry, xs):
         h = carry
         lp, ck, cv = xs
-        h, ck, cv = _layer_step(lp, h, ck, cv, pos, cos, sin, args,
-                                prefill_len)
+        h, ck, cv = _layer_step(lp, h, ck, cv, pos, cos, sin, args)
         return h, (ck, cv)
 
     h, (new_k, new_v) = jax.lax.scan(step, h,
@@ -132,18 +131,21 @@ def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args,
     return logits.astype(jnp.float32), new_k, new_v
 
 
-def _sample(logits, temperature, top_p, key):
-    if temperature == 0.0:
+def _sample(logits, sample, temperature, top_p, key):
+    """sample is the only STATIC switch (argmax vs categorical program
+    structure); temperature/top_p are traced, so serving can vary them per
+    request without recompiling the decode program."""
+    if not sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
-    if top_p < 1.0:
-        # nucleus: mask tokens outside the smallest top-p probability mass
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-        logits = jnp.where(logits >= cutoff, logits, -1e30)
+    # nucleus mask (a no-op when top_p == 1.0: the cutoff lands on the
+    # smallest logit and everything survives)
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    logits = jnp.where(logits >= cutoff, logits, -1e30)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
@@ -157,7 +159,7 @@ def prefill(params, args, prompt_ids, max_len):
                    params["embedding"].dtype)
     cv = jnp.zeros_like(ck)
     cos, sin = lf.rope_tables(max_len, hd, args.rope_theta)
-    return _forward_cached(params, prompt_ids, ck, cv, 0, cos, sin, args, s)
+    return _forward_cached(params, prompt_ids, ck, cv, 0, cos, sin, args)
 
 
 def decode_step(params, args, token, caches_k, caches_v, pos, max_len):
@@ -165,19 +167,32 @@ def decode_step(params, args, token, caches_k, caches_v, pos, max_len):
     hd = args.hidden_size // args.num_heads
     cos, sin = lf.rope_tables(max_len, hd, args.rope_theta)
     return _forward_cached(params, token[:, None], caches_k, caches_v, pos,
-                           cos, sin, args, 1)
+                           cos, sin, args)
 
 
-@functools.partial(jax.jit, static_argnames=("args", "max_new_tokens",
-                                             "temperature", "top_p"))
 def generate(params, args, prompt_ids, max_new_tokens=32, temperature=0.0,
              top_p=1.0, key=None):
     """Whole generation as one compiled program.
 
     prompt_ids: [b, s] int32. Returns [b, s + max_new_tokens] int32.
-    temperature 0 = greedy; top_p < 1 = nucleus sampling (needs key)."""
+    temperature 0 = greedy; top_p < 1 = nucleus sampling. temperature and
+    top_p are traced (vary per call without recompiling); only the
+    greedy/sampling mode switch and shapes are compile-time."""
+    if max_new_tokens <= 0:
+        return jnp.asarray(prompt_ids)
     if key is None:
         key = jax.random.key(0)
+    sample = bool(np.asarray(temperature) != 0.0)
+    return _generate_jit(params, args, jnp.asarray(prompt_ids),
+                         max_new_tokens, sample,
+                         jnp.float32(temperature if sample else 1.0),
+                         jnp.float32(top_p), key)
+
+
+@functools.partial(jax.jit, static_argnames=("args", "max_new_tokens",
+                                             "sample"))
+def _generate_jit(params, args, prompt_ids, max_new_tokens, sample,
+                  temperature, top_p, key):
     b, s = prompt_ids.shape
     max_len = s + max_new_tokens
     hd = args.hidden_size // args.num_heads
@@ -185,16 +200,16 @@ def generate(params, args, prompt_ids, max_new_tokens=32, temperature=0.0,
 
     logits, ck, cv = prefill(params, args, prompt_ids, max_len)
     key, sub = jax.random.split(key)
-    first = _sample(logits, temperature, top_p, sub)
+    first = _sample(logits, sample, temperature, top_p, sub)
     if max_new_tokens == 1:
         return jnp.concatenate([prompt_ids, first[:, None]], axis=1)
 
     def step(carry, xs):
         token, ck, cv, pos, key = carry
         logits, ck, cv = _forward_cached(params, token[:, None], ck, cv, pos,
-                                         cos, sin, args, 1)
+                                         cos, sin, args)
         key, sub = jax.random.split(key)
-        nxt = _sample(logits, temperature, top_p, sub)
+        nxt = _sample(logits, sample, temperature, top_p, sub)
         return (nxt, ck, cv, pos + 1, key), token
 
     (last, *_), toks = jax.lax.scan(

@@ -407,6 +407,12 @@ class LlamaForCausalLM(nn.Layer):
                                temperature=temperature, top_p=top_p,
                                key=jax.random.key(seed))
             return paddle.to_tensor(out)
+        if seed:
+            import warnings
+
+            warnings.warn("generate(seed=...) is only honored on the "
+                          "compiled path; the eager loop draws from the "
+                          "global generator (use paddle.seed)")
         tokens = input_ids
         past = None
         cur = tokens
@@ -415,6 +421,18 @@ class LlamaForCausalLM(nn.Layer):
             next_logits = logits[:, -1, :]
             if temperature and temperature > 0:
                 next_logits = next_logits / temperature
+                if top_p < 1.0:
+                    # nucleus mask, same rule as the compiled sampler
+                    sorted_l = paddle.sort(next_logits, axis=-1,
+                                           descending=True)
+                    probs_s = F.softmax(sorted_l, axis=-1)
+                    cum = paddle.cumsum(probs_s, axis=-1)
+                    k = paddle.sum(paddle.cast(cum < top_p, "int32"),
+                                   axis=-1, keepdim=True)
+                    cutoff = paddle.take_along_axis(sorted_l, k, axis=-1)
+                    next_logits = paddle.where(
+                        next_logits >= cutoff, next_logits,
+                        paddle.full_like(next_logits, -1e30))
                 probs = F.softmax(next_logits, axis=-1)
                 nxt = paddle.multinomial(probs, 1)
             else:
