@@ -116,8 +116,8 @@ def _candidate_configs(backend):
             # step in the layer-scan's dynamic-update-slice residual
             # stacking; unrolling (engine default on a 1x1x1 mesh) freed
             # enough HBM scheduling slack that zero-recompute fits at
-            # 2 accumulated micro-batches. Measured 21.0k tok/s / 0.62 MFU
-            # on v5e (r4 champion 'dots' was 17.7k).
+            # 2 accumulated micro-batches. Measured 21.5k tok/s / 0.64 MFU
+            # on v5e (r4 champion 'dots' was 17.7k; flash blocks 512/1024).
             dict(cfg=h2048, batch=8, seq=1024, remat=False, loss_chunk=128,
                  micro_batches=2),
             # same shape, Adafactor-style factored second moment (~21.2k)
