@@ -297,20 +297,21 @@ def test_local_fs(tmp_path):
     assert not fs.is_exist(d)
 
 
-def test_static_program_guard_warns_once():
+def test_static_program_guard_is_real():
+    """program_guard no longer warns it is a no-op: static-graph capture is
+    implemented (paddle_tpu/static/graph.py) — the guard must isolate the
+    default programs and not emit capture warnings."""
     import warnings
 
     import paddle_tpu.static as static
 
-    static._warned_static_noop = False
+    outer = static.default_main_program()
     with warnings.catch_warnings(record=True) as rec:
         warnings.simplefilter("always")
         with static.program_guard(static.Program()):
-            pass
-        with static.program_guard(static.Program()):
-            pass
-    msgs = [w for w in rec if "static-graph capture" in str(w.message)]
-    assert len(msgs) == 1  # warned exactly once
+            assert static.default_main_program() is not outer
+        assert static.default_main_program() is outer
+    assert not [w for w in rec if "static-graph capture" in str(w.message)]
 
 
 def test_expert_parallel_moe_multi_device():
