@@ -187,12 +187,24 @@ class HybridParallelEngine:
         self.micro_batches = micro_batches or max(pp, 1)
         self.dtype = dtype
         self.remat = remat
-        # unroll the layer loop on the degenerate-mesh fast path (default):
+        # unroll the layer loop whenever layers are NOT sharded (pp == 1):
         # lax.scan must stack every layer's remat residuals into [L, ...]
         # buffers with dynamic-update-slice and re-slice them in backward —
-        # profiled at ~17% of the h2048 train step on TPU v5e. The pipeline
-        # paths keep the scan (pp shards its leading dim).
-        self.unroll = (dp == pp == mp == cp == 1) if unroll is None else unroll
+        # profiled at ~17% of the h2048 train step on TPU v5e. Applies to
+        # the degenerate mesh AND dp/mp/cp-parallel meshes; the pipeline
+        # paths keep the scan (pp shards its leading dim). ACTIVE ZeRO-3
+        # (zero_stage=3 with dp>1) keeps the scan too by default: its
+        # per-layer all-gather dominates the DUS cost and the scan form
+        # keeps the gathered layer's liveness tight.
+        if unroll is None:
+            self.unroll = pp == 1 and (zero_stage < 3 or dp == 1)
+        else:
+            if unroll and pp > 1:
+                raise ValueError(
+                    "unroll=True requires pp == 1: pipeline parallelism "
+                    "shards the layer stack's leading dim, which only the "
+                    "scan form supports")
+            self.unroll = unroll
         self.lr = lr
         # sequence-chunked CE (single-device path only): the [b, s, vocab]
         # f32 logits never materialize at once — vocab matmul + CE run per
@@ -501,7 +513,8 @@ class HybridParallelEngine:
             return lf.run_layers(lp["layers"], h, cos, sin, args, mp_axis, mp,
                                  sp, self.remat, zero_axis=za,
                                  zero_skip=self._zero_skip,
-                                 cp_axis=self._cp_axis, cp_mode=self.cp_mode)
+                                 cp_axis=self._cp_axis, cp_mode=self.cp_mode,
+                                 unroll=self.unroll)
 
         perm = [(i, i + 1) for i in range(S - 1)]
 

@@ -256,6 +256,11 @@ def run_layers(stack, h, cos, sin, args: LlamaArgs, mp_axis=None, mp_degree=1,
         remat = True
     if remat == "half":
         ck = jax.checkpoint(body)
+        if unroll:
+            for i in range(stack_leading_dim(stack)):
+                lp = jax.tree.map(lambda a: a[i], stack)
+                h = (body if i % 2 == 0 else ck)(lp, h, cos, sin)
+            return h
 
         def pair_step(carry, lp2):
             lp_a = jax.tree.map(lambda a: a[0], lp2)
