@@ -170,11 +170,13 @@ def _run_single(spec_json):
 def _bench_int8(steps=32, warmup=4):
     """Weight-only int8 vs bf16 inference through the saved-model Predictor
     (jit.save -> StableHLO -> PJRT): tokens/sec of a small-batch Llama
-    forward (the latency-bound serving shape, where each matmul's rows <<
-    the compute/bandwidth break-even and weight STREAMING dominates — the
-    regime weight-only quantization exists for). The int8 export streams
-    matmul weights from HBM at 1/4 width with the dequant fused into the
-    matmul; embeddings stay float (gather can't fuse the dequant)."""
+    forward. Measured honestly: on TPU via plain StableHLO the dequant
+    (convert+scale) is NOT fused into the matmul by XLA — the full-width
+    weights re-materialize per call — so weight-only int8 ships at a
+    throughput COST (~0.75-0.85x bf16 across prefill and decode-like
+    shapes on v5e); its win is the halved checkpoint/HBM footprint.
+    True int8 acceleration is the activation-quantized PTQ path
+    (quantize='int8_ptq': int8 x int8 -> int32 on the MXU)."""
     import tempfile
 
     import paddle_tpu as paddle
