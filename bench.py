@@ -175,8 +175,10 @@ def _bench_int8(steps=32, warmup=4):
     weights re-materialize per call — so weight-only int8 ships at a
     throughput COST (~0.75-0.85x bf16 across prefill and decode-like
     shapes on v5e); its win is the halved checkpoint/HBM footprint.
-    True int8 acceleration is the activation-quantized PTQ path
-    (quantize='int8_ptq': int8 x int8 -> int32 on the MXU)."""
+    The activation-quantized PTQ path (quantize='int8_ptq', int8 x int8
+    -> int32) measures ~1.0x bf16 on v5e through StableHLO — int8 dots
+    do not currently lower to an accelerated MXU path here either, so
+    both quantized exports are footprint features on this stack."""
     import tempfile
 
     import paddle_tpu as paddle
