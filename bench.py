@@ -133,6 +133,10 @@ def _candidate_configs(backend):
             dict(cfg=h2048, batch=32, seq=1024, remat=False, loss_chunk=128,
                  micro_batches=8),
             # wide-shallow h4096 + s2048: long-seq flash fwd+bwd, MXU-heavy
+            # (no-remat + unroll: 19.6k / 0.66 MFU on v5e; full-remat
+            # fallback kept for smaller-HBM chips)
+            dict(cfg=h4096, batch=4, seq=2048, remat=False, loss_chunk=128,
+                 micro_batches=2),
             dict(cfg=h4096, batch=4, seq=2048, remat=True),
             # fallback if the chip is small
             dict(cfg=small, batch=8, seq=1024, remat=True),
@@ -172,9 +176,10 @@ def _bench_int8(steps=32, warmup=4):
     (jit.save -> StableHLO -> PJRT): tokens/sec of a small-batch Llama
     forward. Measured honestly: on TPU via plain StableHLO the dequant
     (convert+scale) is NOT fused into the matmul by XLA — the full-width
-    weights re-materialize per call — so weight-only int8 ships at a
-    throughput COST (~0.75-0.85x bf16 across prefill and decode-like
-    shapes on v5e); its win is the halved checkpoint/HBM footprint.
+    weights re-materialize per call — so weight-only int8 shows NO
+    reliable speedup (0.75-1.1x bf16 across shapes and runs on v5e; the
+    spread is tunnel/dispatch variance); its win is the halved
+    checkpoint/HBM footprint.
     The activation-quantized PTQ path (quantize='int8_ptq', int8 x int8
     -> int32) measures ~1.0x bf16 on v5e through StableHLO — int8 dots
     do not currently lower to an accelerated MXU path here either, so
@@ -255,9 +260,10 @@ def main():
         if backend == "tpu" and results and cfg_kw["hidden_size"] == 1024:
             break  # the small config is only a fallback when nothing ran
         if (backend == "tpu" and cand.get("remat") is True
-                and cfg_kw["hidden_size"] == 2048
-                and any(r["cfg"]["hidden_size"] == 2048 for r in results)):
-            continue  # full-remat h2048 fallback only needed if dots failed
+                and any(r["cfg"]["hidden_size"] == cfg_kw["hidden_size"]
+                        for r in results)):
+            continue  # full-remat fallbacks only run if the shape has no
+            #           successful result yet (smaller-HBM chips)
         spec = json.dumps(cand)
         label = (f"h{cfg_kw['hidden_size']}_l{cfg_kw['num_hidden_layers']}"
                  f"_s{seq}_b{batch}_remat-{cand.get('remat', True)}"
