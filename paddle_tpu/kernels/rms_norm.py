@@ -1,0 +1,139 @@
+"""Fused RMSNorm forward + backward as Pallas TPU kernels.
+
+Reference counterpart: `paddle/phi/kernels/gpu/rms_norm_kernel.cu` /
+`rms_norm_grad_kernel.cu` (fused CUDA kernels behind
+`paddle.incubate.nn.functional.fused_rms_norm`).
+
+STATUS — measured, and NOT dispatched by default anywhere: on TPU v5e
+the XLA-compiled jnp composite beats this kernel both standalone
+(2.8 vs 3.5 ms fwd+bwd at [8192, 2048]: the cross-block dw accumulation
+serializes the grid) and inside the train step (a pallas_call is a
+fusion barrier; swapping it into the Llama hot path cost 21.5k -> 20.3k
+tok/s). Unlike CUDA — where the reference NEEDS the fused kernel because
+its eager composite launches several kernels — XLA already emits the
+optimal fusion here. Kept as a tested reference Pallas implementation
+and a recorded negative result.
+
+Math (RMSNorm, y = x * r * w with r = rsqrt(mean_H(x^2) + eps)):
+  dx_i = r * (gw_i - x_i * r^2 * mean_H(gw * x)),   gw = g * w
+  dw   = sum_rows(g * x * r)
+Grad-checked against the jnp composition in tests/test_rms_norm_kernel.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK_ROWS = 256
+
+
+def _fwd_kernel(x_ref, w_ref, y_ref, r_ref, *, eps):
+    x = x_ref[0].astype(jnp.float32)            # [rows, H]
+    w = w_ref[...].astype(jnp.float32)          # [H]
+    r = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    y_ref[0] = (x * r * w[None, :]).astype(y_ref.dtype)
+    r_ref[0] = r
+
+
+def _bwd_kernel(x_ref, w_ref, r_ref, g_ref, dx_ref, dw_ref):
+    i = pl.program_id(0)
+    x = x_ref[0].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    r = r_ref[0]                                 # [rows, 1] f32
+    g = g_ref[0].astype(jnp.float32)
+    gw = g * w[None, :]
+    m = jnp.mean(gw * x, axis=-1, keepdims=True)
+    dx_ref[0] = (r * (gw - x * (r * r) * m)).astype(dx_ref.dtype)
+    # dw accumulates across row-block grid steps into the SAME output block
+    dw_part = jnp.sum(g * x * r, axis=0)
+
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[...] = dw_part
+
+    @pl.when(i > 0)
+    def _acc():
+        dw_ref[...] = dw_ref[...] + dw_part
+
+
+def _pick_rows(n, pref=_BLOCK_ROWS):
+    b = pref
+    while b > 8 and n % b != 0:
+        b //= 2
+    return b if n % b == 0 else 1
+
+
+def _fwd_call(x2d, w, eps, interpret):
+    n, h = x2d.shape
+    rows = _pick_rows(n)
+    kern = functools.partial(_fwd_kernel, eps=eps)
+    y, r = pl.pallas_call(
+        kern,
+        out_shape=(jax.ShapeDtypeStruct((1, n, h), x2d.dtype),
+                   jax.ShapeDtypeStruct((1, n, 1), jnp.float32)),
+        grid=(n // rows,),
+        in_specs=[pl.BlockSpec((1, rows, h), lambda i: (0, i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,))],
+        out_specs=(pl.BlockSpec((1, rows, h), lambda i: (0, i, 0)),
+                   pl.BlockSpec((1, rows, 1), lambda i: (0, i, 0))),
+        interpret=interpret,
+    )(x2d[None], w)
+    return y[0], r[0]
+
+
+def _bwd_call(x2d, w, r, g2d, interpret):
+    n, h = x2d.shape
+    rows = _pick_rows(n)
+    dx, dw = pl.pallas_call(
+        _bwd_kernel,
+        out_shape=(jax.ShapeDtypeStruct((1, n, h), x2d.dtype),
+                   jax.ShapeDtypeStruct((h,), jnp.float32)),
+        grid=(n // rows,),
+        in_specs=[pl.BlockSpec((1, rows, h), lambda i: (0, i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,)),
+                  pl.BlockSpec((1, rows, 1), lambda i: (0, i, 0)),
+                  pl.BlockSpec((1, rows, h), lambda i: (0, i, 0))],
+        out_specs=(pl.BlockSpec((1, rows, h), lambda i: (0, i, 0)),
+                   pl.BlockSpec((h,), lambda i: (0,))),
+        interpret=interpret,
+    )(x2d[None], w, r[None], g2d[None])
+    return dx[0], dw
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def rms_norm(x, w, eps=1e-6, interpret=False):
+    """Fused RMSNorm over the last dim. x: [..., H]; w: [H].
+    Output dtype follows x; the normalization math runs in f32."""
+    return _rn_fwd(x, w, eps, interpret)[0]
+
+
+def _rn_fwd(x, w, eps, interpret):
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    y, r = _fwd_call(x2d, w, eps, interpret)
+    return y.reshape(shape), (x2d, w, r)
+
+
+def _rn_bwd(eps, interpret, res, g):
+    x2d, w, r = res
+    g2d = g.reshape(x2d.shape)
+    dx, dw = _bwd_call(x2d, w, r, g2d, interpret)
+    return dx.reshape(g.shape), dw.astype(w.dtype)
+
+
+rms_norm.defvjp(_rn_fwd, _rn_bwd)
+
+
+def supports(shape):
+    """The kernels want a lane-aligned feature dim and an 8-aligned row
+    count after flattening."""
+    import numpy as np
+
+    if len(shape) < 2:
+        return False
+    n = int(np.prod(shape[:-1]))
+    return shape[-1] % 128 == 0 and n % 8 == 0

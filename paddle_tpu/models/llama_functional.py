@@ -102,6 +102,13 @@ def init_params(args: LlamaArgs, key, dtype=jnp.float32):
 
 
 def rms_norm(x, w, eps):
+    # Deliberately the jnp composition, NOT the Pallas kernel
+    # (kernels/rms_norm.py): inside the compiled train step a pallas_call
+    # is a fusion BARRIER — measured 21.5k -> 20.3k tok/s on the v5e
+    # champion config when swapped in, because XLA can no longer fold the
+    # norm into the neighboring matmul prologues. (The Pallas pair also
+    # lost standalone; see its module docstring — it is dispatched
+    # nowhere and kept as a recorded negative result.)
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
     return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w

@@ -1,0 +1,89 @@
+"""Fused RMSNorm Pallas kernel (kernels/rms_norm.py) vs the jnp composite.
+
+Reference parity target: `paddle/phi/kernels/gpu/rms_norm_kernel.cu` math
+(normalize in f32, scale by weight). Runs in interpret mode on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.kernels import rms_norm as rn
+
+
+def _ref(x, w, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w
+
+
+@pytest.mark.parametrize("shape,h", [((8, 16, 256), 256), ((32, 128), 128),
+                                     ((2, 8, 384), 384)])
+def test_forward_parity(shape, h):
+    x = jax.random.normal(jax.random.key(0), shape, jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (h,), jnp.float32) + 1.0
+    got = rn.rms_norm(x, w, 1e-6, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_ref(x, w, 1e-6)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_bf16_dtype():
+    x = jax.random.normal(jax.random.key(0), (16, 256), jnp.bfloat16)
+    w = jnp.ones((256,), jnp.bfloat16)
+    got = rn.rms_norm(x, w, 1e-6, True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(_ref(x, w, 1e-6), np.float32),
+        rtol=2e-2, atol=2e-2)
+
+
+def test_grads_match_composite():
+    x = jax.random.normal(jax.random.key(2), (8, 8, 256), jnp.float32)
+    w = jax.random.normal(jax.random.key(3), (256,), jnp.float32) + 1.0
+    p = jax.random.normal(jax.random.key(4), (8, 8, 256), jnp.float32)
+
+    def loss_k(x, w):
+        return jnp.sum(rn.rms_norm(x, w, 1e-6, True) * p)
+
+    def loss_r(x, w):
+        return jnp.sum(_ref(x, w, 1e-6) * p)
+
+    gx_k, gw_k = jax.grad(loss_k, argnums=(0, 1))(x, w)
+    gx_r, gw_r = jax.grad(loss_r, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx_k), np.asarray(gx_r),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw_k), np.asarray(gw_r),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_grads_under_jit_and_row_blocking():
+    # rows > one block: dw must accumulate across grid steps
+    n_rows = 1024  # 4 blocks of 256
+    x = jax.random.normal(jax.random.key(5), (n_rows, 128), jnp.float32)
+    w = jnp.ones((128,), jnp.float32)
+
+    @jax.jit
+    def g(x, w):
+        return jax.grad(
+            lambda x, w: jnp.sum(rn.rms_norm(x, w, 1e-6, True) ** 2),
+            argnums=(0, 1))(x, w)
+
+    gx_k, gw_k = g(x, w)
+    gx_r, gw_r = jax.grad(
+        lambda x, w: jnp.sum(_ref(x, w, 1e-6) ** 2), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx_k), np.asarray(gx_r),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw_k), np.asarray(gw_r),
+                               rtol=1e-4, atol=2e-4)
+
+
+def test_supports():
+    assert rn.supports((8, 16, 256))
+    assert not rn.supports((8, 16, 100))  # not lane-aligned
+    assert not rn.supports((256,))        # needs a row dim
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))
