@@ -36,6 +36,8 @@ from paddle_tpu.distributed.parallel import (  # noqa: F401
     init_parallel_env, get_rank, get_world_size, ParallelEnv, DataParallel,
 )
 from paddle_tpu.distributed.engine import Engine  # noqa: F401
+from paddle_tpu.distributed.mesh_utils import (  # noqa: F401
+    create_hybrid_mesh, slice_count)
 from paddle_tpu.distributed.pipeline_engine import (  # noqa: F401
     PipelineEngine, transformer_mp_spec,
 )

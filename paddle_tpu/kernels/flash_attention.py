@@ -35,11 +35,15 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
-def _pick_block(seq, preferred):
-    """Largest power-of-two block <= preferred that divides seq."""
+def _pick_block(seq, preferred, floor=128, fallback=None):
+    """Largest power-of-two block <= preferred that divides seq, not going
+    below `floor`; `fallback` (if set) is returned when even the floor does
+    not divide seq. Shared by the attention kernels and kernels/rms_norm."""
     b = preferred
-    while b > 128 and seq % b != 0:
+    while b > floor and seq % b != 0:
         b //= 2
+    if fallback is not None and seq % b != 0:
+        return fallback
     return b
 
 

@@ -61,10 +61,9 @@ def _bwd_kernel(x_ref, w_ref, r_ref, g_ref, dx_ref, dw_ref):
 
 
 def _pick_rows(n, pref=_BLOCK_ROWS):
-    b = pref
-    while b > 8 and n % b != 0:
-        b //= 2
-    return b if n % b == 0 else 1
+    from paddle_tpu.kernels.flash_attention import _pick_block
+
+    return _pick_block(n, pref, floor=8, fallback=1)
 
 
 def _fwd_call(x2d, w, eps, interpret):
