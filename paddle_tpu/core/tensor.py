@@ -449,6 +449,14 @@ _op_tracer = None  # profiler hook: fn(op_name, host_seconds) on the waist
 _op_capture = None     # fn(op_fn, in_tensors, cast_arrays, outs, name, grad)
 _concrete_hook = None  # fn(tensor, kind, python_value) on bool/int/float/item
 _mutation_hook = None  # fn(tensor, why) before a non-waist in-place mutation
+# every Tensor method that calls _mutation_hook (keep in sync when adding
+# in-place methods) — consumed by jit.sot's bytecode pre-scan so its break
+# diagnosis matches the runtime capture behavior
+MUTATION_METHODS = frozenset({
+    "numpy", "tolist", "copy_", "set_value", "add_", "subtract_",
+    "multiply_", "scale_", "clip_", "zero_", "fill_", "normal_",
+    "uniform_", "exponential_",
+})
 # Static-graph recorder (paddle_tpu.static.graph): when set AND an input is
 # an abstract Variable, the waist records the op into the active Program
 # (eval_shape only, no execution) instead of running it.

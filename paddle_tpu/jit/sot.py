@@ -475,6 +475,11 @@ class SotFunction:
                 "uncapturable": sorted(set(self._uncapturable.values())),
                 **self.stats}
 
+    def diagnose(self):
+        """Static bytecode pre-scan of the wrapped function: where it will
+        guard, fork plans, or break capture (see scan_function)."""
+        return scan_function(self._fn)
+
 
 _registry = []
 
@@ -496,3 +501,75 @@ def sot_report():
     """Aggregate capture/guard/fallback stats over every translated function
     (the reference's `paddle.jit.sot` InfoCollector summary)."""
     return [sf.report() for sf in _registry]
+
+
+# --------------------------------------------------------------------------
+# bytecode pre-scan (diagnostics)
+# --------------------------------------------------------------------------
+
+# method names whose appearance on a traced value maps to a capture event.
+# The break set is the SAME registry the runtime mutation hook covers
+# (core/tensor.py MUTATION_METHODS), so diagnosis cannot drift from
+# behavior when in-place methods are added.
+_SCAN_GUARD_METHODS = {"item": "value guard (equality; recaptures on change)"}
+_SCAN_BREAK_METHODS = {
+    m: ("materialization break (falls back to eager)"
+        if m in ("numpy", "tolist") else "in-place mutation break")
+    for m in _tc.MUTATION_METHODS
+}
+_SCAN_CAST_FNS = {"float": "value guard", "int": "value guard",
+                  "bool": "bool guard (branch; one plan per outcome)"}
+
+
+def scan_function(fn):
+    """Static bytecode scan (reference: the SOT opcode translator walks the
+    same instruction stream to DECIDE; here the walk DIAGNOSES — execution
+    capture happens on the dispatch waist, so this scan has zero soundness
+    burden and exists to tell users ahead of time where a function will
+    guard, fork plans, or fall back).
+
+    Returns {"guards": [...], "breaks": [...], "branches": [...]}, each
+    entry (line, detail). Heuristic: attribute/global names are matched
+    textually; a tensor-valued jump is flagged as a potential plan fork.
+    """
+    import dis
+    import types
+
+    code = getattr(fn, "__code__", None)
+    if code is None and hasattr(fn, "__call__"):
+        code = getattr(fn.__call__, "__code__", None)
+    guards, breaks, branches = [], [], []
+    if code is None:
+        return {"guards": guards, "breaks": breaks, "branches": branches}
+
+    def walk(co):
+        line = co.co_firstlineno
+        for ins in dis.get_instructions(co):
+            # positions.lineno is stable across 3.11+ (starts_line changed
+            # type to bool in 3.13)
+            pos = getattr(ins, "positions", None)
+            if pos is not None and pos.lineno:
+                line = pos.lineno
+            name = ins.argval if isinstance(ins.argval, str) else None
+            if ins.opname in ("LOAD_ATTR", "LOAD_METHOD") and name:
+                if name in _SCAN_GUARD_METHODS:
+                    guards.append((line, f".{name}(): "
+                                   f"{_SCAN_GUARD_METHODS[name]}"))
+                elif name in _SCAN_BREAK_METHODS:
+                    breaks.append((line, f".{name}(): "
+                                   f"{_SCAN_BREAK_METHODS[name]}"))
+            elif ins.opname == "LOAD_GLOBAL" and name in _SCAN_CAST_FNS:
+                guards.append((line, f"{name}(): {_SCAN_CAST_FNS[name]}"))
+            elif ins.opname.startswith("POP_JUMP"):
+                # covers POP_JUMP_IF_* (3.12) and the FORWARD/BACKWARD
+                # variants (3.11)
+                branches.append(
+                    (line, "conditional jump: if the predicate is a traced "
+                           "tensor this is a bool guard (one cached plan "
+                           "per outcome)"))
+        for const in co.co_consts:
+            if isinstance(const, types.CodeType):
+                walk(const)  # lambdas, inner defs, genexprs
+
+    walk(code)
+    return {"guards": guards, "breaks": breaks, "branches": branches}

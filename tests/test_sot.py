@@ -364,3 +364,59 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+class TestBytecodeScan:
+    def test_diagnose_flags_branch_and_guard(self):
+        def f(x):
+            s = float(x.max())        # value guard
+            if x.sum() > 0:           # branch -> bool guard
+                return x * s
+            return x
+
+        sf = symbolic_translate(f)
+        d = sf.diagnose()
+        assert any("value guard" in msg for _, msg in d["guards"])
+        assert d["branches"]  # the if is visible at bytecode level
+
+    def test_diagnose_flags_breaks(self):
+        def f(x):
+            h = x * 2.0
+            h.scale_(3.0)             # mutation break
+            _ = h.numpy()             # materialization break
+            return h
+
+        sf = symbolic_translate(f)
+        d = sf.diagnose()
+        msgs = [m for _, m in d["breaks"]]
+        assert any("mutation" in m for m in msgs)
+        assert any("materialization" in m for m in msgs)
+
+    def test_diagnose_clean_function(self):
+        sf = symbolic_translate(lambda x: (x * 2.0 + 1.0).sum())
+        d = sf.diagnose()
+        assert not d["breaks"] and not d["branches"]
+
+    def test_diagnosis_matches_runtime_outcome(self):
+        # the scan PREDICTS what the capture machinery then actually does
+        def f(x):
+            h = x + 1.0
+            h.scale_(2.0)
+            return h
+
+        sf = symbolic_translate(f)
+        assert sf.diagnose()["breaks"]
+        sf(t([1.0]))
+        sf(t([1.0]))
+        assert sf.report()["uncapturable"]  # predicted break happened
+
+    def test_diagnose_sees_nested_code_objects(self):
+        def f(x):
+            g = lambda: x.numpy()                       # noqa: E731
+            total = sum(v.item() for v in [x])
+            return g(), total
+
+        sf = symbolic_translate(f)
+        d = sf.diagnose()
+        assert any("materialization" in m for _, m in d["breaks"])
+        assert any("value guard" in m for _, m in d["guards"])
