@@ -477,8 +477,14 @@ class SotFunction:
 
     def diagnose(self):
         """Static bytecode pre-scan of the wrapped function: where it will
-        guard, fork plans, or break capture (see scan_function)."""
-        return scan_function(self._fn)
+        guard, fork plans, or break capture (see scan_function). For a
+        translated Layer the scan targets its `forward` — `__call__` is a
+        two-line dispatch wrapper whose bytecode says nothing."""
+        target = self._fn
+        holder = getattr(target, "__self__", None)
+        if holder is not None and hasattr(holder, "forward"):
+            target = holder.forward
+        return scan_function(target)
 
 
 _registry = []

@@ -27,9 +27,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from typing import NamedTuple
+
 from paddle_tpu.models import llama_functional as lf
 
-__all__ = ["generate", "params_from_layer", "prefill", "decode_step"]
+__all__ = ["generate", "params_from_layer", "prefill", "decode_step",
+           "gpt_generate", "gpt_params_from_layer", "GPTGenArgs"]
 
 
 def params_from_layer(model):
@@ -65,6 +68,31 @@ def params_from_layer(model):
     }
 
 
+def _cached_attention(q, cache_k, cache_v, pos):
+    """Masked attention of q [b, s, nh, hd] over the full fixed-size cache
+    [b, max_len, nkv, hd] (invalid slots masked by position — static shapes
+    every step). Shared by the Llama and GPT decode layers."""
+    b, s, nh, hd = q.shape
+    max_len, nkv = cache_k.shape[1], cache_k.shape[2]
+    if nkv != nh:
+        rep = nh // nkv
+        kk = jnp.repeat(cache_k, rep, axis=2)
+        vv = jnp.repeat(cache_v, rep, axis=2)
+    else:
+        kk, vv = cache_k, cache_v
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(kk, 1, 2)
+    vh = jnp.swapaxes(vv, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(hd)
+    key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len), 3)
+    query_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len),
+                                               2)
+    scores = jnp.where(key_pos <= query_pos, scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vh.dtype), vh)
+    return jnp.swapaxes(attn, 1, 2)
+
+
 def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
     """One decoder layer over `h` [b, s, hid] with a fixed-size cache.
 
@@ -86,26 +114,8 @@ def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
     cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, k, pos, axis=1)
     cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, v, pos, axis=1)
 
-    max_len = cache_k.shape[1]
-    if nkv != nh:
-        rep = nh // nkv
-        kk = jnp.repeat(cache_k, rep, axis=2)
-        vv = jnp.repeat(cache_v, rep, axis=2)
-    else:
-        kk, vv = cache_k, cache_v
-    # [b, heads, s, max_len] scores over the whole cache buffer; invalid
-    # slots masked by position — static shapes every step
-    qh = jnp.swapaxes(q, 1, 2)
-    kh = jnp.swapaxes(kk, 1, 2)
-    vh = jnp.swapaxes(vv, 1, 2)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(hd)
-    key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len), 3)
-    query_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len),
-                                               2)
-    scores = jnp.where(key_pos <= query_pos, scores, -1e30)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
-    attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vh.dtype), vh)
-    attn = jnp.swapaxes(attn, 1, 2).reshape(b, s, nh * hd)
+    attn = _cached_attention(q, cache_k, cache_v, pos)
+    attn = attn.reshape(b, s, nh * hd)
     h = h + attn @ lp["wo"]
 
     hin = lf.rms_norm(h, lp["ln2"], args.rms_eps)
@@ -147,6 +157,32 @@ def _sample(logits, sample, temperature, top_p, key):
     cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
     logits = jnp.where(logits >= cutoff, logits, -1e30)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
+                 temperature, top_p, key):
+    """Shared prefill->sample->scan->concat driver (traced inside the
+    per-architecture jit): fwd(ids, ck, cv, pos) -> (logits, ck, cv)."""
+    b, s = prompt_ids.shape
+    logits, ck, cv = fwd(prompt_ids, ck, cv, 0)
+    key, sub = jax.random.split(key)
+    first = _sample(logits, sample, temperature, top_p, sub)
+    if max_new_tokens == 1:
+        return jnp.concatenate([prompt_ids, first[:, None]], axis=1)
+
+    def step(carry, xs):
+        token, ck, cv, pos, key = carry
+        logits, ck, cv = fwd(token[:, None], ck, cv, pos)
+        key, sub = jax.random.split(key)
+        nxt = _sample(logits, sample, temperature, top_p, sub)
+        return (nxt, ck, cv, pos + 1, key), token
+
+    (last, *_), toks = jax.lax.scan(
+        step, (first, ck, cv, jnp.int32(s), key), None,
+        length=max_new_tokens - 1)
+    new_tokens = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]],
+                                 axis=1)
+    return jnp.concatenate([prompt_ids, new_tokens], axis=1)
 
 
 def prefill(params, args, prompt_ids, max_len):
@@ -218,3 +254,150 @@ def _generate_jit(params, args, prompt_ids, max_new_tokens, sample,
     new_tokens = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]],
                                  axis=1)
     return jnp.concatenate([prompt_ids, new_tokens], axis=1)
+
+
+# --------------------------------------------------------------------------
+# GPT-2 family (models/gpt.py): pre-LN blocks, learned positions, tied head
+# --------------------------------------------------------------------------
+
+
+class GPTGenArgs(NamedTuple):
+    """Static (hashable) GPT shape for the compiled decode."""
+
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    max_position_embeddings: int
+    ln_eps: float = 1e-5
+
+    @staticmethod
+    def from_config(cfg):
+        return GPTGenArgs(cfg.vocab_size, cfg.hidden_size,
+                          cfg.num_hidden_layers, cfg.num_attention_heads,
+                          cfg.max_position_embeddings,
+                          getattr(cfg, "layer_norm_eps", 1e-5))
+
+
+def gpt_params_from_layer(model):
+    """Stack an eager `GPTForCausalLM`/`GPTModel` into a functional tree
+    (weights [in, out]; biases as-is; layers stacked on a leading [L])."""
+    core = getattr(model, "gpt", model)
+
+    def arr(t):
+        return t._data if hasattr(t, "_data") else jnp.asarray(t)
+
+    names = [
+        ("ln1_w", lambda l: arr(l.ln1.weight)),
+        ("ln1_b", lambda l: arr(l.ln1.bias)),
+        ("wq", lambda l: arr(l.attn.q_proj.weight)),
+        ("bq", lambda l: arr(l.attn.q_proj.bias)),
+        ("wk", lambda l: arr(l.attn.k_proj.weight)),
+        ("bk", lambda l: arr(l.attn.k_proj.bias)),
+        ("wv", lambda l: arr(l.attn.v_proj.weight)),
+        ("bv", lambda l: arr(l.attn.v_proj.bias)),
+        ("wo", lambda l: arr(l.attn.out_proj.weight)),
+        ("bo", lambda l: arr(l.attn.out_proj.bias)),
+        ("ln2_w", lambda l: arr(l.ln2.weight)),
+        ("ln2_b", lambda l: arr(l.ln2.bias)),
+        ("fc1_w", lambda l: arr(l.fc1.weight)),
+        ("fc1_b", lambda l: arr(l.fc1.bias)),
+        ("fc2_w", lambda l: arr(l.fc2.weight)),
+        ("fc2_b", lambda l: arr(l.fc2.bias)),
+    ]
+    stacked = {k: jnp.stack([get(l) for l in core.layers])
+               for k, get in names}
+    return {
+        "word_emb": arr(core.embeddings.word_embeddings.weight),
+        "pos_emb": arr(core.embeddings.position_embeddings.weight),
+        "layers": stacked,
+        "lnf_w": arr(core.final.ln_f.weight),
+        "lnf_b": arr(core.final.ln_f.bias),
+    }
+
+
+def _layer_norm(x, w, b, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean((x32 - mu) ** 2, axis=-1, keepdims=True)
+    return (((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * w + b)
+
+
+def _gpt_layer_step(lp, h, cache_k, cache_v, pos, args: GPTGenArgs):
+    b, s = h.shape[0], h.shape[1]
+    nh = args.num_heads
+    hd = args.hidden_size // nh
+
+    hin = _layer_norm(h, lp["ln1_w"], lp["ln1_b"], args.ln_eps)
+    q = (hin @ lp["wq"] + lp["bq"]).reshape(b, s, nh, hd)
+    k = (hin @ lp["wk"] + lp["bk"]).reshape(b, s, nh, hd)
+    v = (hin @ lp["wv"] + lp["bv"]).reshape(b, s, nh, hd)
+    cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, k, pos, axis=1)
+    cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, v, pos, axis=1)
+    attn = _cached_attention(q, cache_k, cache_v, pos).reshape(b, s, nh * hd)
+    h = h + (attn @ lp["wo"] + lp["bo"])
+
+    hin = _layer_norm(h, lp["ln2_w"], lp["ln2_b"], args.ln_eps)
+    act = jax.nn.gelu(hin @ lp["fc1_w"] + lp["fc1_b"], approximate=False)
+    h = h + (act @ lp["fc2_w"] + lp["fc2_b"])
+    return h, cache_k, cache_v
+
+
+def _gpt_forward_cached(params, ids, caches_k, caches_v, pos,
+                        args: GPTGenArgs):
+    b, s = ids.shape
+    positions = pos + jnp.arange(s, dtype=jnp.int32)
+    h = (jnp.take(params["word_emb"], ids, axis=0)
+         + jnp.take(params["pos_emb"], positions, axis=0)[None])
+
+    def step(carry, lp_kv):
+        h = carry
+        lp, ck, cv = lp_kv
+        h, ck, cv = _gpt_layer_step(lp, h, ck, cv, pos, args)
+        return h, (ck, cv)
+
+    h, (new_k, new_v) = jax.lax.scan(step, h,
+                                     (params["layers"], caches_k, caches_v))
+    h = _layer_norm(h, params["lnf_w"], params["lnf_b"], args.ln_eps)
+    logits = h[:, -1, :] @ params["word_emb"].T  # tied head
+    return logits.astype(jnp.float32), new_k, new_v
+
+
+def gpt_generate(params, args: GPTGenArgs, prompt_ids, max_new_tokens=32,
+                 temperature=0.0, top_p=1.0, key=None):
+    """GPT-2 whole-generation-as-one-program (same machinery as the Llama
+    `generate`; learned positions bound max_len by
+    args.max_position_embeddings)."""
+    if max_new_tokens <= 0:
+        return jnp.asarray(prompt_ids)
+    if key is None:
+        key = jax.random.key(0)
+    b, s = np.asarray(prompt_ids).shape
+    if s + max_new_tokens > args.max_position_embeddings:
+        raise ValueError(
+            f"prompt {s} + max_new_tokens {max_new_tokens} exceeds the "
+            f"learned position table ({args.max_position_embeddings})")
+    sample = bool(np.asarray(temperature) != 0.0)
+    return _gpt_generate_jit(params, args, jnp.asarray(prompt_ids),
+                             max_new_tokens, sample,
+                             jnp.float32(temperature if sample else 1.0),
+                             jnp.float32(top_p), key)
+
+
+@functools.partial(jax.jit, static_argnames=("args", "max_new_tokens",
+                                             "sample"))
+def _gpt_generate_jit(params, args, prompt_ids, max_new_tokens, sample,
+                      temperature, top_p, key):
+    b, s = prompt_ids.shape
+    max_len = s + max_new_tokens
+    L = args.num_layers
+    hd = args.hidden_size // args.num_heads
+    ck = jnp.zeros((L, b, max_len, args.num_heads, hd),
+                   params["word_emb"].dtype)
+    cv = jnp.zeros_like(ck)
+
+    def fwd(ids, ck, cv, pos):
+        return _gpt_forward_cached(params, ids, ck, cv, pos, args)
+
+    return _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
+                        temperature, top_p, key)

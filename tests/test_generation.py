@@ -95,3 +95,54 @@ if __name__ == "__main__":
     import sys
 
     sys.exit(pytest.main([__file__, "-x", "-q"]))
+
+
+class TestGPTCompiledDecode:
+    @pytest.fixture(scope="class")
+    def gpt_and_params(self):
+        from paddle_tpu.models.generation import (GPTGenArgs,
+                                                  gpt_params_from_layer)
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=96, hidden_size=48, intermediate_size=96,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        max_position_embeddings=64, hidden_dropout_prob=0.0)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        return model, gpt_params_from_layer(model), GPTGenArgs.from_config(cfg)
+
+    def test_bridge_matches_eager_forward(self, gpt_and_params):
+        from paddle_tpu.models.generation import _gpt_forward_cached
+        import jax.numpy as jnp
+
+        model, params, args = gpt_and_params
+        ids = np.array([[3, 17, 42, 9]], np.int32)
+        eager = model(paddle.to_tensor(ids)).numpy()[:, -1, :]
+        L, hd = args.num_layers, args.hidden_size // args.num_heads
+        ck = jnp.zeros((L, 1, 4, args.num_heads, hd), jnp.float32)
+        logits, _, _ = _gpt_forward_cached(params, ids, ck,
+                                           jnp.zeros_like(ck), 0, args)
+        np.testing.assert_allclose(np.asarray(logits), eager,
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_greedy_matches_full_context_rescoring(self, gpt_and_params):
+        from paddle_tpu.models.generation import gpt_generate
+
+        model, params, args = gpt_and_params
+        ids = np.array([[9, 3]], np.int32)
+        out = np.asarray(gpt_generate(params, args, ids, max_new_tokens=6))
+        ctx = ids
+        for t_ in range(6):
+            logits = model(paddle.to_tensor(ctx)).numpy()
+            nxt = int(np.argmax(logits[0, -1]))
+            assert nxt == out[0, ids.shape[1] + t_], f"step {t_}"
+            ctx = np.concatenate([ctx, [[nxt]]], axis=1)
+
+    def test_position_table_bound(self, gpt_and_params):
+        from paddle_tpu.models.generation import gpt_generate
+
+        _, params, args = gpt_and_params
+        ids = np.zeros((1, 60), np.int32)
+        with pytest.raises(ValueError, match="position"):
+            gpt_generate(params, args, ids, max_new_tokens=8)

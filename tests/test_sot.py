@@ -420,3 +420,14 @@ class TestBytecodeScan:
         d = sf.diagnose()
         assert any("materialization" in m for _, m in d["breaks"])
         assert any("value guard" in m for _, m in d["guards"])
+
+    def test_diagnose_scans_layer_forward(self):
+        class Bad(paddle.nn.Layer):
+            def forward(self, x):
+                h = x * 2.0
+                h.scale_(3.0)
+                return h
+
+        sf = symbolic_translate(Bad())
+        d = sf.diagnose()
+        assert any("mutation" in m for _, m in d["breaks"])
