@@ -482,7 +482,10 @@ class SotFunction:
         two-line dispatch wrapper whose bytecode says nothing."""
         target = self._fn
         holder = getattr(target, "__self__", None)
-        if holder is not None and hasattr(holder, "forward"):
+        if (holder is not None and hasattr(holder, "forward")
+                and getattr(target, "__name__", "") == "__call__"):
+            # only the Layer dispatch wrapper redirects; a bound method
+            # like model.encode is scanned as itself
             target = holder.forward
         return scan_function(target)
 

@@ -160,41 +160,61 @@ def _sample(logits, sample, temperature, top_p, key):
 
 
 def _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
-                 temperature, top_p, key):
+                 temperature, top_p, key, use_eos=False, eos_id=0, pad_id=0):
     """Shared prefill->sample->scan->concat driver (traced inside the
-    per-architecture jit): fwd(ids, ck, cv, pos) -> (logits, ck, cv)."""
+    per-architecture jit): fwd(ids, ck, cv, pos) -> (logits, ck, cv).
+
+    use_eos (the only STATIC eos switch — program structure): rows that
+    emit eos_id are DONE and emit pad_id from then on (the output stays a
+    static [b, s + max_new_tokens] rectangle; per-row dynamic lengths
+    would defeat the one-program design). eos_id/pad_id themselves are
+    traced operands, so changing token ids never recompiles. The scan
+    still runs max_new_tokens steps — XLA cannot early-exit a compiled
+    loop — but finished rows carry a done mask, matching the reference's
+    eager stopping criterion semantically."""
     b, s = prompt_ids.shape
     logits, ck, cv = fwd(prompt_ids, ck, cv, 0)
     key, sub = jax.random.split(key)
     first = _sample(logits, sample, temperature, top_p, sub)
+    done0 = first == eos_id if use_eos else jnp.zeros((b,), bool)
     if max_new_tokens == 1:
         return jnp.concatenate([prompt_ids, first[:, None]], axis=1)
 
     def step(carry, xs):
-        token, ck, cv, pos, key = carry
+        token, ck, cv, pos, key, done = carry
         logits, ck, cv = fwd(token[:, None], ck, cv, pos)
         key, sub = jax.random.split(key)
         nxt = _sample(logits, sample, temperature, top_p, sub)
-        return (nxt, ck, cv, pos + 1, key), token
+        if use_eos:
+            nxt = jnp.where(done, pad_id.astype(jnp.int32), nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, ck, cv, pos + 1, key, done), token
 
     (last, *_), toks = jax.lax.scan(
-        step, (first, ck, cv, jnp.int32(s), key), None,
+        step, (first, ck, cv, jnp.int32(s), key, done0), None,
         length=max_new_tokens - 1)
     new_tokens = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]],
                                  axis=1)
     return jnp.concatenate([prompt_ids, new_tokens], axis=1)
 
 
-def prefill(params, args, prompt_ids, max_len):
-    """Run the prompt through the model once, filling the caches.
-    Returns (next_logits [b, vocab], caches_k, caches_v)."""
+def _init_cache(params, args, b, max_len):
+    """Fixed-size KV cache buffers + RoPE tables — shared by the public
+    prefill/decode_step incremental API and the compiled generate."""
     L = lf.stack_leading_dim(params["layers"])
-    b, s = prompt_ids.shape
     hd = args.hidden_size // args.num_heads
     ck = jnp.zeros((L, b, max_len, args.num_kv_heads, hd),
                    params["embedding"].dtype)
     cv = jnp.zeros_like(ck)
     cos, sin = lf.rope_tables(max_len, hd, args.rope_theta)
+    return ck, cv, cos, sin
+
+
+def prefill(params, args, prompt_ids, max_len):
+    """Run the prompt through the model once, filling the caches.
+    Returns (next_logits [b, vocab], caches_k, caches_v)."""
+    b, s = prompt_ids.shape
+    ck, cv, cos, sin = _init_cache(params, args, b, max_len)
     return _forward_cached(params, prompt_ids, ck, cv, 0, cos, sin, args)
 
 
@@ -207,53 +227,44 @@ def decode_step(params, args, token, caches_k, caches_v, pos, max_len):
 
 
 def generate(params, args, prompt_ids, max_new_tokens=32, temperature=0.0,
-             top_p=1.0, key=None):
+             top_p=1.0, key=None, eos_token_id=None, pad_token_id=0):
     """Whole generation as one compiled program.
 
     prompt_ids: [b, s] int32. Returns [b, s + max_new_tokens] int32.
     temperature 0 = greedy; top_p < 1 = nucleus sampling. temperature and
     top_p are traced (vary per call without recompiling); only the
-    greedy/sampling mode switch and shapes are compile-time."""
+    greedy/sampling mode switch and shapes are compile-time.
+    eos_token_id: rows that emit it produce pad_token_id afterwards (the
+    output stays rectangular)."""
     if max_new_tokens <= 0:
         return jnp.asarray(prompt_ids)
     if key is None:
         key = jax.random.key(0)
     sample = bool(np.asarray(temperature) != 0.0)
+    use_eos = eos_token_id is not None
     return _generate_jit(params, args, jnp.asarray(prompt_ids),
                          max_new_tokens, sample,
                          jnp.float32(temperature if sample else 1.0),
-                         jnp.float32(top_p), key)
+                         jnp.float32(top_p), key, use_eos,
+                         jnp.int32(eos_token_id if use_eos else 0),
+                         jnp.int32(pad_token_id))
 
 
 @functools.partial(jax.jit, static_argnames=("args", "max_new_tokens",
-                                             "sample"))
+                                             "sample", "use_eos"))
 def _generate_jit(params, args, prompt_ids, max_new_tokens, sample,
-                  temperature, top_p, key):
+                  temperature, top_p, key, use_eos=False, eos_id=0,
+                  pad_id=0):
     b, s = prompt_ids.shape
     max_len = s + max_new_tokens
-    hd = args.hidden_size // args.num_heads
-    cos, sin = lf.rope_tables(max_len, hd, args.rope_theta)
+    ck, cv, cos, sin = _init_cache(params, args, b, max_len)
 
-    logits, ck, cv = prefill(params, args, prompt_ids, max_len)
-    key, sub = jax.random.split(key)
-    first = _sample(logits, sample, temperature, top_p, sub)
-    if max_new_tokens == 1:
-        return jnp.concatenate([prompt_ids, first[:, None]], axis=1)
+    def fwd(ids, ck, cv, pos):
+        return _forward_cached(params, ids, ck, cv, pos, cos, sin, args)
 
-    def step(carry, xs):
-        token, ck, cv, pos, key = carry
-        logits, ck, cv = _forward_cached(params, token[:, None], ck, cv, pos,
-                                         cos, sin, args)
-        key, sub = jax.random.split(key)
-        nxt = _sample(logits, sample, temperature, top_p, sub)
-        return (nxt, ck, cv, pos + 1, key), token
-
-    (last, *_), toks = jax.lax.scan(
-        step, (first, ck, cv, jnp.int32(s), key), None,
-        length=max_new_tokens - 1)
-    new_tokens = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]],
-                                 axis=1)
-    return jnp.concatenate([prompt_ids, new_tokens], axis=1)
+    return _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
+                        temperature, top_p, key, use_eos,
+                        jnp.asarray(eos_id), jnp.asarray(pad_id))
 
 
 # --------------------------------------------------------------------------
@@ -364,10 +375,11 @@ def _gpt_forward_cached(params, ids, caches_k, caches_v, pos,
 
 
 def gpt_generate(params, args: GPTGenArgs, prompt_ids, max_new_tokens=32,
-                 temperature=0.0, top_p=1.0, key=None):
+                 temperature=0.0, top_p=1.0, key=None, eos_token_id=None,
+                 pad_token_id=0):
     """GPT-2 whole-generation-as-one-program (same machinery as the Llama
-    `generate`; learned positions bound max_len by
-    args.max_position_embeddings)."""
+    `generate`, incl. eos early-stop semantics; learned positions bound
+    max_len by args.max_position_embeddings)."""
     if max_new_tokens <= 0:
         return jnp.asarray(prompt_ids)
     if key is None:
@@ -378,16 +390,20 @@ def gpt_generate(params, args: GPTGenArgs, prompt_ids, max_new_tokens=32,
             f"prompt {s} + max_new_tokens {max_new_tokens} exceeds the "
             f"learned position table ({args.max_position_embeddings})")
     sample = bool(np.asarray(temperature) != 0.0)
+    use_eos = eos_token_id is not None
     return _gpt_generate_jit(params, args, jnp.asarray(prompt_ids),
                              max_new_tokens, sample,
                              jnp.float32(temperature if sample else 1.0),
-                             jnp.float32(top_p), key)
+                             jnp.float32(top_p), key, use_eos,
+                             jnp.int32(eos_token_id if use_eos else 0),
+                             jnp.int32(pad_token_id))
 
 
 @functools.partial(jax.jit, static_argnames=("args", "max_new_tokens",
-                                             "sample"))
+                                             "sample", "use_eos"))
 def _gpt_generate_jit(params, args, prompt_ids, max_new_tokens, sample,
-                      temperature, top_p, key):
+                      temperature, top_p, key, use_eos=False, eos_id=0,
+                      pad_id=0):
     b, s = prompt_ids.shape
     max_len = s + max_new_tokens
     L = args.num_layers
@@ -400,4 +416,5 @@ def _gpt_generate_jit(params, args, prompt_ids, max_new_tokens, sample,
         return _gpt_forward_cached(params, ids, ck, cv, pos, args)
 
     return _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
-                        temperature, top_p, key)
+                        temperature, top_p, key, use_eos,
+                        jnp.asarray(eos_id), jnp.asarray(pad_id))

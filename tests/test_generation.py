@@ -146,3 +146,25 @@ class TestGPTCompiledDecode:
         ids = np.zeros((1, 60), np.int32)
         with pytest.raises(ValueError, match="position"):
             gpt_generate(params, args, ids, max_new_tokens=8)
+
+
+class TestEosStopping:
+    def test_eos_rows_pad_after_stop(self, model_and_params):
+        _, params, args = model_and_params
+        ids = np.array([[5, 11, 7]], np.int32)
+        # find what greedy emits, then declare ITS first new token the eos:
+        # everything after must be pad
+        base = np.asarray(generate(params, args, ids, max_new_tokens=6))
+        eos = int(base[0, 3])
+        out = np.asarray(generate(params, args, ids, max_new_tokens=6,
+                                  eos_token_id=eos, pad_token_id=0))
+        assert out[0, 3] == eos
+        np.testing.assert_array_equal(out[0, 4:], np.zeros(5, np.int32))
+
+    def test_no_eos_means_unchanged(self, model_and_params):
+        _, params, args = model_and_params
+        ids = np.array([[5, 11, 7]], np.int32)
+        a = np.asarray(generate(params, args, ids, max_new_tokens=6))
+        b = np.asarray(generate(params, args, ids, max_new_tokens=6,
+                                eos_token_id=None))
+        np.testing.assert_array_equal(a, b)
