@@ -196,7 +196,17 @@ def test_multiprocess_loader_propagates_worker_exception():
 def test_multiprocess_loader_overlaps_input_pipeline():
     """4 workers on a slow dataset must beat single-process by a wide
     margin (the input pipeline is no longer serialized)."""
+    import os
     import time
+
+    load = os.getloadavg()[0]
+    ncpu = os.cpu_count() or 1
+    if load > ncpu * 0.75:
+        # a wall-clock overlap assertion is meaningless on a saturated
+        # box: 4 workers genuinely cannot overlap when every core is busy
+        # (observed flaking only while the TPU bench ran concurrently)
+        pytest.skip(f"host load {load:.1f} too high for a timing test "
+                    f"({ncpu} cpus)")
 
     def run(num_workers):
         loader = paddle.io.DataLoader(_SlowDs(), batch_size=4,
