@@ -282,14 +282,27 @@ class Predictor:
                     else:
                         seen[h] = i
         # bf16_weights_pass: halve parameter HBM; run() casts back to the
-        # export dtype on the fly (a transient f32 view per call)
+        # export dtype on the fly (a transient f32 view per call). Cast
+        # through an id()-keyed memo: a fresh astype() array per aliased
+        # entry would destroy the dedup aliasing above (device_put keys on
+        # id(a)), silently cancelling the two passes — tied weights must
+        # still share ONE device buffer after the cast.
         self._cast_params = "bf16_weights_pass" in names
         if self._cast_params:
             import jax.numpy as jnp
 
-            params = [np.asarray(a).astype(jnp.bfloat16)
-                      if np.asarray(a).dtype == np.float32 else a
-                      for a in params]
+            memo = {}
+
+            def cast(a):
+                out = memo.get(id(a))
+                if out is None:
+                    arr = np.asarray(a)
+                    out = (arr.astype(jnp.bfloat16)
+                           if arr.dtype == np.float32 else a)
+                    memo[id(a)] = out
+                return out
+
+            params = [cast(a) for a in params]
         return params
 
     def get_input_names(self):

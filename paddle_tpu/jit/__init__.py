@@ -408,14 +408,17 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
     (`python/paddle/static/io.py:513`, `api/analysis_predictor.cc`).
     Without input_spec only the weights are saved (state-dict style).
 
-    quantize="weight_only_int8": every 2-D floating matmul weight is stored
-    int8 with a per-out-channel scale, and the exported program dequantizes
-    it inline right before use (reference: the quant passes under
-    `analysis_predictor.cc` / PaddleSlim's save_quantized_model). On TPU
-    the win is HBM bandwidth — weights stream at 1/4 width and XLA fuses
-    the dequant multiply into the consumer matmul; the math runs bf16/f32
-    (weight-only, activations untouched). The Predictor needs no special
-    mode: scales ride as extra parameters of the export.
+    quantize="weight_only_int8": every quantizable Linear weight is stored
+    int8 with a per-out-channel scale, and the exported program computes
+    the matmul through the fused dequant-matmul dispatch
+    (kernels/quantized_matmul): on a TPU-only export (platforms=("tpu",))
+    that traces the Pallas kernel — weights stream from HBM as int8 and
+    the scale is applied in-registers after the MACs, the reference
+    weight_only_linear_kernel's fusion; on a portable cpu+tpu export the
+    jnp dequantize-then-matmul is traced instead (a Mosaic call cannot
+    lower for cpu; XLA folds what it can). The Predictor needs no special
+    mode: scales ride as extra parameters of the export
+    (`<weight key>.__scale__`).
 
     quantize="int8_ptq" (+ calib_reader=<iterable of input batches>):
     activation-int8 PTQ — min-max observers calibrate per-layer input
@@ -440,7 +443,7 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
     if quantize is not None and input_spec is None:
         raise ValueError("quantize requires input_spec (the dequant is part "
                          "of the exported program)")
-    ptq_keys, ptq_cm = [], None
+    quant_keys, quant_cm = [], None
     if quantize == "int8_ptq":
         if calib_reader is None:
             raise ValueError("quantize='int8_ptq' requires calib_reader="
@@ -452,7 +455,14 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
         # calibration runs NOW (eager, unpatched model); the patch itself is
         # entered right before tracing so an input_spec parse error cannot
         # leave the live model int8-patched
-        ptq_cm = int8_patched(target, calibrate_absmax(target, calib_reader))
+        quant_cm = int8_patched(target, calibrate_absmax(target, calib_reader))
+    elif quantize == "weight_only_int8":
+        from paddle_tpu.quantization import weight_only_int8_patched
+
+        # fused Pallas dequant-matmul only on a TPU-only export: a portable
+        # cpu+tpu program must stay Mosaic-free
+        quant_cm = weight_only_int8_patched(
+            target, fused=(tuple(platforms or ("cpu", "tpu")) == ("tpu",)))
 
     if input_spec is not None:
         from jax import export as jax_export
@@ -494,45 +504,16 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
         was_training = getattr(target, "training", False)
         target.eval()
         try:
-            if ptq_cm is not None:
-                # live from functionalize (captures int8 weights as params)
-                # through export (traces the int8 forwards)
-                ptq_keys = ptq_cm.__enter__()
+            if quant_cm is not None:
+                # live from functionalize (captures int8 weights + scales as
+                # params) through export (traces the quantized forwards)
+                quant_keys = quant_cm.__enter__()
             pure_fn, params, buffers = functionalize(target)
-
-            qdtypes = {}  # quantized key -> original dtype
-            if quantize == "weight_only_int8":
-                qparams = {}
-                for k, v in params.items():
-                    # matmul weights only — like the reference's quant
-                    # passes, which rewrite mul/matmul ops and leave lookup
-                    # tables float: a gather can't fuse with the dequant
-                    # multiply, so a pre-dequantized embedding table would
-                    # materialize in full every run
-                    if (v.ndim == 2 and min(v.shape) >= 16
-                            and "embed" not in k.lower()
-                            and jnp.issubdtype(v.dtype, jnp.floating)):
-                        a = np.asarray(v, np.float32)
-                        scale = np.maximum(np.abs(a).max(axis=0) / 127.0,
-                                           1e-9)
-                        q = np.clip(np.round(a / scale), -127, 127)
-                        qparams[k] = jnp.asarray(q.astype(np.int8))
-                        qparams[k + ".__scale__"] = jnp.asarray(
-                            scale.astype(np.float32))
-                        qdtypes[k] = v.dtype
-                    else:
-                        qparams[k] = v
-                params = qparams
 
             param_keys = list(params.keys())
 
             def infer_fn(*flat):
                 ps = dict(zip(param_keys, flat[:len(param_keys)]))
-                for k, dt in qdtypes.items():
-                    # inline weight-only dequant: int8 [in,out] x f32 [out];
-                    # XLA fuses this into the consumer matmul
-                    ps[k] = (ps[k].astype(jnp.float32)
-                             * ps.pop(k + ".__scale__")).astype(dt)
                 out, _ = pure_fn(ps, buffers, key, *flat[len(param_keys):])
                 return out
 
@@ -548,8 +529,8 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
         finally:
             if was_training:
                 target.train()
-            if ptq_cm is not None:
-                ptq_cm.__exit__(None, None, None)
+            if quant_cm is not None:
+                quant_cm.__exit__(None, None, None)
         meta.update({
             "stablehlo": exported.serialize(),
             "input_names": input_names,
@@ -559,9 +540,7 @@ def save(layer, path, input_spec=None, quantize=None, platforms=None,
         })
         if quantize is not None:
             meta["quantize"] = quantize
-            meta["quantized_keys"] = (sorted(qdtypes)
-                                      if quantize == "weight_only_int8"
-                                      else sorted(ptq_keys))
+            meta["quantized_keys"] = sorted(quant_keys)
         state = {k: np.asarray(v) for k, v in params.items()}
 
     with open(path + ".pdiparams", "wb") as f:

@@ -1,3 +1,7 @@
 """Pallas TPU kernels — the hot fused ops the reference implements in CUDA
 (`paddle/phi/kernels/gpu/flash_attn_kernel.cu`, `paddle/phi/kernels/fusion/gpu/`).
+
+- flash_attention: blockwise online-softmax attention, fwd + bwd (training).
+- quantized_matmul: fused int8 dequant-matmul + single-query decode
+  attention (the weight-only quantized serving fast path).
 """

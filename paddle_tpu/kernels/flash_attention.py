@@ -50,8 +50,10 @@ def _pick_block(seq, preferred, floor=128, fallback=None):
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying `like`'s varying-mesh-axes type, so the
     kernels compose with shard_map(check_vma=True) (e.g. under the hybrid
-    engine's mp axis or ring attention's cp axis)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    engine's mp axis or ring attention's cp axis). `jax.typeof` only exists
+    on newer jax; older versions have no vma tracking to propagate."""
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

@@ -1,0 +1,361 @@
+"""Fused int8 dequant-matmul + decode attention: the quantized-decode
+fast path as Pallas TPU kernels.
+
+Reference counterparts: `paddle/phi/kernels/gpu/weight_only_linear_kernel.cu`
+(fused dequant-GEMM — weights stay int8 in memory, per-channel scales applied
+after the MACs) and
+`paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu`
+(single-query decode attention over the growing cache).
+
+Why a kernel and not XLA: through plain StableHLO the weight-only dequant
+(`convert(int8) * scale`) is materialized as a full-width bf16 weight in HBM
+before every matmul, so small-batch decode pays the int8 read AND a bf16
+round trip — measured 0.892x bf16 (BENCH_r05 `int8_weight_only_infer`).
+Small-batch decode is weight-stream bound, so the only lever is bytes moved:
+
+- `fused_dequant_matmul`: int8 weight tiles DMA from HBM into VMEM at 1-byte
+  width, upcast + per-output-channel scale happen in-registers between the
+  load and the MXU, the f32 accumulator is scaled once per output tile.
+  Weight-stream bytes halve vs bf16; nothing full-width ever touches HBM.
+- `decode_attention`: one query row (s_new=1) against the fixed-size KV
+  cache, online max/sum bounded to the valid prefix `[0, pos]` — the full
+  flash kernel (and the jnp fallback) recompute softmax over the whole
+  padded cache length and, under GQA, `jnp.repeat` the cache to the full
+  head count; here kv heads are read once and the loop stops at the
+  position watermark.
+
+Dispatch: `weight_only_matmul` / `decode_attention` pick Pallas on TPU and
+a jnp composition elsewhere; `fused_dispatch(...)` overrides the choice
+(interpret-mode CPU tests, multi-platform exports that must stay
+Pallas-free). Layouts at the public boundary: activations `[..., K]`,
+weights `[K, N]` int8, scales `[N]` (absmax convention: dequant is
+`q * scale / 127`), caches `[b, n_kv_heads, max_len, head_dim]`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.kernels.flash_attention import _pick_block
+
+__all__ = ["fused_dequant_matmul", "weight_only_matmul", "decode_attention",
+           "fused_dispatch", "fused_enabled", "matmul_supported",
+           "decode_supported", "quantize_absmax"]
+
+_NEG_INF = -1e30
+
+# (use_pallas, interpret) override; None = auto (Pallas on TPU, compiled)
+_OVERRIDE = None
+
+
+@contextlib.contextmanager
+def fused_dispatch(enabled=True, interpret=False):
+    """Force the dispatch decision for the scope: enabled=True routes to the
+    Pallas kernels (interpret=True runs them in the Pallas interpreter — the
+    CPU test path), enabled=False forces the jnp composition (multi-platform
+    jax.export traces, which cannot carry a TPU-only Mosaic call)."""
+    global _OVERRIDE
+    saved = _OVERRIDE
+    _OVERRIDE = (enabled, interpret)
+    try:
+        yield
+    finally:
+        _OVERRIDE = saved
+
+
+def _mode():
+    if _OVERRIDE is not None:
+        return _OVERRIDE
+    return jax.default_backend() == "tpu", False
+
+
+def fused_enabled():
+    """True when dispatch would pick the Pallas kernels (TPU, or forced by
+    fused_dispatch)."""
+    return _mode()[0]
+
+
+# the kernels stream whole weight/cache blocks through VMEM; stay well under
+# the ~16 MB/core budget (same discipline as kernels/flash_attention)
+_VMEM_BUDGET_BYTES = 10 * 1024 * 1024
+
+
+def _round_up(x, m):
+    return -(-x // m) * m
+
+
+# ---------------------------------------------------------------------------
+# fused dequant-matmul
+# ---------------------------------------------------------------------------
+
+
+def _dqmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, block_k, n_kb,
+                 k_total):
+    # blocks: x [bm, bk]; w [bk, bn] int8; s [1, bn] f32; o [bm, bn];
+    # acc scratch [bm, bn] f32, revisited across the innermost k grid dim
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x = x_ref[...]
+    w = w_ref[...]
+    k_start = ki * block_k
+    if k_total % block_k != 0:
+        # K-tail block: the out-of-range tail of a partial block holds
+        # arbitrary padding — zero BOTH operands so 0*garbage never leaks
+        # a NaN into the accumulator
+        rows = k_start + jax.lax.broadcasted_iota(jnp.int32, w.shape, 0)
+        w = jnp.where(rows < k_total, w, 0)
+        cols = k_start + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        x = jnp.where(cols < k_total, x, 0)
+    # the fusion: int8 -> activation dtype in-registers (every int8 value is
+    # exact in bf16), straight to the MXU with an f32 accumulator — the
+    # full-width weight never exists outside registers
+    acc_ref[...] += jax.lax.dot_general(
+        x, w.astype(x.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kb - 1)
+    def _finish():
+        # per-output-channel epilogue: one multiply of the f32 accumulator
+        o_ref[...] = (acc_ref[...] * (s_ref[0] / 127.0)).astype(o_ref.dtype)
+
+
+def fused_dequant_matmul(x, w, scale, out_dtype=None, block_m=256,
+                         block_n=512, block_k=512, interpret=False):
+    """`x @ (w * scale / 127)` with w int8 [K, N] staying int8 through HBM
+    and VMEM; scale [N] is the per-output-channel absmax. x: [..., K]
+    (leading dims flatten into M — decode batches are tiny, the M tile pads).
+    Tile-remainder shapes on any of M/N/K are handled by in-kernel masking
+    (K) and dropped out-of-range writes (M/N)."""
+    *lead, k_total = x.shape
+    n_total = w.shape[1]
+    x2 = x.reshape(-1, k_total)
+    m_total = x2.shape[0]
+    out_dtype = out_dtype or x.dtype
+
+    # round the M tile to the widest dtype's sublane minimum (int8: 32) so
+    # tiny decode batches land on a natively-tileable block
+    bm = min(block_m, _round_up(m_total, 32))
+    bn = min(block_n, _round_up(n_total, 128))
+    bk = min(block_k, _round_up(k_total, 128))
+    n_kb = pl.cdiv(k_total, bk)
+    grid = (pl.cdiv(m_total, bm), pl.cdiv(n_total, bn), n_kb)
+
+    out = pl.pallas_call(
+        functools.partial(_dqmm_kernel, block_k=bk, n_kb=n_kb,
+                          k_total=k_total),
+        out_shape=jax.ShapeDtypeStruct((m_total, n_total), out_dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, ki: (i, ki)),
+            pl.BlockSpec((bk, bn), lambda i, j, ki: (ki, j)),
+            pl.BlockSpec((1, bn), lambda i, j, ki: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, ki: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+    )(x2, w, scale.reshape(1, n_total).astype(jnp.float32))
+    return out.reshape(*lead, n_total)
+
+
+def matmul_supported(x_shape, w_shape, itemsize=2, block_n=512, block_k=512):
+    """True when the fused kernel can take x [..., K] @ w [K, N] int8:
+    2-D weight and a per-grid-step working set that fits VMEM."""
+    if len(w_shape) != 2 or x_shape[-1] != w_shape[0]:
+        return False
+    k_total, n_total = w_shape
+    if k_total < 1 or n_total < 1:
+        return False
+    m = 1
+    for d in x_shape[:-1]:
+        m *= d
+    bm = min(256, _round_up(m, 32))
+    bn = min(block_n, _round_up(n_total, 128))
+    bk = min(block_k, _round_up(k_total, 128))
+    # per-step residency: int8 w tile + x tile + f32 acc + out, double-buffered
+    per_step = 2 * (bk * bn + bm * bk * itemsize) + bm * bn * (4 + itemsize)
+    return per_step <= _VMEM_BUDGET_BYTES
+
+
+def quantize_absmax(w):
+    """Per-out-channel absmax int8 quantization of [..., K, N] weights:
+    (q int8, scale [..., N] f32) with dequant = q * scale / 127 — the ONE
+    convention every quantized entry point shares (weight_quantize, the
+    weight_only_int8 export patch, generation.quantize_params) and the
+    fused kernel's /127 epilogue assumes."""
+    a = jnp.asarray(w, jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(a), axis=-2), 1e-9)
+    q = jnp.clip(jnp.round(a / scale[..., None, :] * 127.0), -127,
+                 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequant_matmul_xla(x, w, scale, out_dtype=None):
+    """The unfused reference: dequantize to the activation dtype, then
+    matmul (what XLA gets through plain StableHLO — also the fallback and
+    the parity oracle for the kernel tests)."""
+    wf = w.astype(x.dtype) * (scale.astype(x.dtype) / 127.0)
+    out = x @ wf
+    return out.astype(out_dtype) if out_dtype else out
+
+
+def weight_only_matmul(x, w, scale, out_dtype=None):
+    """Dispatch waist for weight-only int8 matmuls: the fused Pallas kernel
+    on TPU (or when forced by `fused_dispatch`), the jnp composition
+    elsewhere. All inference entry points (quantization.weight_only_linear,
+    the weight_only_int8 export patch, generation's quantized decode) route
+    through here."""
+    use_pallas, interpret = _mode()
+    if use_pallas and w.dtype == jnp.int8 and \
+            matmul_supported(x.shape, w.shape, x.dtype.itemsize):
+        try:
+            return fused_dequant_matmul(x, w, scale, out_dtype,
+                                        interpret=interpret)
+        except Exception as e:  # lowering constraints supports() can't model
+            # loud fallback, as kernels/flash_attention: real kernel bugs
+            # must surface, not vanish silently
+            import warnings
+
+            warnings.warn(
+                f"Pallas fused dequant-matmul failed ({type(e).__name__}: "
+                f"{e}); falling back to the XLA composition for "
+                f"x={x.shape} w={w.shape}")
+    return _dequant_matmul_xla(x, w, scale, out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# decode attention (single query vs the static KV cache)
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale):
+    # blocks: q/o [1, 1, g, d] (the g query heads sharing this kv head);
+    # k/v [1, 1, max_len, d]; pos is scalar-prefetched
+    pos = pos_ref[0]
+    q = q_ref[0, 0]  # [g, d]
+    g, d = q.shape
+
+    m0 = jnp.full((g, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    acc0 = jnp.zeros((g, d), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [g, bk]
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (g, block_k), 1)
+        s = jnp.where(cols <= pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    # the decode specialization: the loop stops at the position watermark —
+    # cache slots past `pos` are never scored (the flash kernel and the jnp
+    # fallback softmax over the full padded max_len every step)
+    n_kb = (pos + block_k) // block_k  # cdiv(pos + 1, block_k), pos >= 0
+    acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def decode_supported(q_shape, cache_shape, itemsize=2):
+    """True when the Pallas decode kernel can take q [b, 1, nh, hd] against
+    cache [b, nkv, max_len, hd]: single query, 128-aligned cache length,
+    query heads a multiple of kv heads, working set within VMEM."""
+    if len(q_shape) != 4 or q_shape[1] != 1:
+        return False
+    nh, hd = q_shape[2], q_shape[3]
+    nkv, max_len = cache_shape[1], cache_shape[2]
+    if max_len % 128 != 0 or nkv <= 0 or nh % nkv != 0:
+        return False
+    # k + v streamed whole per (batch, kv head) grid step, double-buffered
+    per_step = 2 * 2 * max_len * hd * itemsize
+    return per_step <= _VMEM_BUDGET_BYTES
+
+
+def _decode_attention_pallas(q, cache_k, cache_v, pos, sm_scale, block_k,
+                             interpret):
+    b, _, nh, hd = q.shape
+    nkv, max_len = cache_k.shape[1], cache_k.shape[2]
+    g = nh // nkv
+    bk = _pick_block(max_len, min(block_k, max_len))
+    q4 = q[:, 0].reshape(b, nkv, g, hd)
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, hd), lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, max_len, hd),
+                         lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, max_len, hd),
+                         lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd),
+                               lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_k=bk, sm_scale=sm_scale),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(pos_arr, q4, cache_k, cache_v)
+    return out.reshape(b, nh, hd)[:, None]
+
+
+def _decode_attention_xla(q, cache_k, cache_v, pos, sm_scale):
+    """Masked full-length reference (static shapes; what _cached_attention
+    computes at s=1) — fallback and parity oracle."""
+    b, _, nh, hd = q.shape
+    nkv, max_len = cache_k.shape[1], cache_k.shape[2]
+    if nkv != nh:
+        cache_k = jnp.repeat(cache_k, nh // nkv, axis=1)
+        cache_v = jnp.repeat(cache_v, nh // nkv, axis=1)
+    qh = jnp.swapaxes(q, 1, 2)  # [b, nh, 1, hd]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, cache_k) * sm_scale
+    key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, max_len), 3)
+    scores = jnp.where(key_pos <= pos, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(cache_v.dtype), cache_v)
+    return jnp.swapaxes(attn, 1, 2)
+
+
+def decode_attention(q, cache_k, cache_v, pos, scale=None, block_k=512):
+    """Single-query attention of q [b, 1, nh, hd] over the fixed-size cache
+    [b, nkv, max_len, hd], valid prefix [0, pos] (pos is the traced write
+    position of q's own k/v — the decode step of the compiled generate).
+    GQA native: kv heads are never repeated."""
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    use_pallas, interpret = _mode()
+    if use_pallas and decode_supported(q.shape, cache_k.shape,
+                                       q.dtype.itemsize):
+        try:
+            return _decode_attention_pallas(q, cache_k, cache_v, pos,
+                                            sm_scale, block_k, interpret)
+        except Exception as e:  # lowering constraints supports() can't model
+            import warnings
+
+            warnings.warn(
+                f"Pallas decode attention failed ({type(e).__name__}: {e}); "
+                f"falling back to the XLA path for q={q.shape} "
+                f"cache={cache_k.shape}")
+    return _decode_attention_xla(q, cache_k, cache_v, pos, sm_scale)

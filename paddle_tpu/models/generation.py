@@ -7,16 +7,23 @@ grown cache (`LlamaForCausalLM.generate`-style loops; cache plumbing in
 shapes force a recompile per length, so the TPU-native design is the
 static-shape serving loop:
 
-  - the KV cache is ONE fixed buffer [L, B, max_len, Hkv, D] written with
-    `dynamic_update_slice` at the current position;
+  - the KV cache is ONE fixed buffer [L, B, Hkv, max_len, D] written with
+    `dynamic_update_slice` at the current position (heads-major: the layout
+    the attention kernels consume directly, so no per-step transpose);
   - attention masks invalid cache slots (iota > pos) instead of slicing a
-    dynamic length — every step has identical shapes;
+    dynamic length — every step has identical shapes; on TPU the decode
+    step (s_new=1) runs the Pallas decode-attention kernel
+    (kernels/quantized_matmul.decode_attention), whose online max/sum stops
+    at the position watermark instead of re-softmaxing the padded length;
   - the entire decode (prefill + lax.scan over steps + greedy/temperature/
     top-p sampling) traces into ONE `jax.jit`, so a 128-token generation
     is one device program launch, not 128 Python round-trips.
 
 Works over the pure-functional param tree (`llama_functional`);
 `params_from_layer` bridges a trained eager `LlamaForCausalLM` into it.
+`quantize_params` converts the tree to weight-only int8 (QuantizedWeight
+leaves); the same `generate` then streams int8 weights through the fused
+Pallas dequant-matmul — the quantized-decode fast path.
 """
 
 from __future__ import annotations
@@ -32,7 +39,51 @@ from typing import NamedTuple
 from paddle_tpu.models import llama_functional as lf
 
 __all__ = ["generate", "params_from_layer", "prefill", "decode_step",
-           "gpt_generate", "gpt_params_from_layer", "GPTGenArgs"]
+           "gpt_generate", "gpt_params_from_layer", "GPTGenArgs",
+           "QuantizedWeight", "quantize_params"]
+
+
+class QuantizedWeight(NamedTuple):
+    """Weight-only int8 leaf in a functional param tree: `q` int8 [..., K, N]
+    with per-out-channel absmax `scale` [..., N] (dequant = q * scale / 127).
+    A pytree node, so stacked [L, ...] leaves slice per layer under
+    lax.scan like plain weights."""
+
+    q: jax.Array
+    scale: jax.Array
+
+
+def _quantize_weight(w):
+    from paddle_tpu.kernels.quantized_matmul import quantize_absmax
+
+    return QuantizedWeight(*quantize_absmax(w))
+
+
+_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params):
+    """Weight-only int8 quantization of a Llama functional param tree for
+    decode: every per-layer matmul weight and the lm_head become
+    QuantizedWeight leaves (embedding and norms stay float — a gather
+    cannot fuse with the dequant). `generate` consumes the result
+    unchanged; its matmuls stream int8 through the fused Pallas kernel."""
+    layers = {k: (_quantize_weight(v) if k in _QUANT_KEYS else v)
+              for k, v in params["layers"].items()}
+    out = dict(params, layers=layers)
+    out["lm_head"] = _quantize_weight(params["lm_head"])
+    return out
+
+
+def _wmm(x, w):
+    """Matmul that understands QuantizedWeight leaves: float weights take
+    the plain `@`, int8 weights stream through the fused dequant-matmul
+    dispatch (Pallas on TPU, jnp elsewhere)."""
+    if isinstance(w, QuantizedWeight):
+        from paddle_tpu.kernels import quantized_matmul as qm
+
+        return qm.weight_only_matmul(x, w.q, w.scale, out_dtype=x.dtype)
+    return x @ w
 
 
 def params_from_layer(model):
@@ -70,19 +121,26 @@ def params_from_layer(model):
 
 def _cached_attention(q, cache_k, cache_v, pos):
     """Masked attention of q [b, s, nh, hd] over the full fixed-size cache
-    [b, max_len, nkv, hd] (invalid slots masked by position — static shapes
-    every step). Shared by the Llama and GPT decode layers."""
+    [b, nkv, max_len, hd] (invalid slots masked by position — static shapes
+    every step). Shared by the Llama and GPT decode layers. The decode step
+    (s == 1) dispatches to the Pallas decode-attention kernel when
+    supported: single query against the cache, online max/sum bounded to
+    the valid prefix, GQA without repeating kv heads."""
     b, s, nh, hd = q.shape
-    max_len, nkv = cache_k.shape[1], cache_k.shape[2]
+    nkv, max_len = cache_k.shape[1], cache_k.shape[2]
+    if s == 1:
+        from paddle_tpu.kernels import quantized_matmul as qm
+
+        if qm.fused_enabled() and qm.decode_supported(
+                q.shape, cache_k.shape, q.dtype.itemsize):
+            return qm.decode_attention(q, cache_k, cache_v, pos)
     if nkv != nh:
         rep = nh // nkv
-        kk = jnp.repeat(cache_k, rep, axis=2)
-        vv = jnp.repeat(cache_v, rep, axis=2)
+        kh = jnp.repeat(cache_k, rep, axis=1)
+        vh = jnp.repeat(cache_v, rep, axis=1)
     else:
-        kk, vv = cache_k, cache_v
+        kh, vh = cache_k, cache_v
     qh = jnp.swapaxes(q, 1, 2)
-    kh = jnp.swapaxes(kk, 1, 2)
-    vh = jnp.swapaxes(vv, 1, 2)
     scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(hd)
     key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len), 3)
     query_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len),
@@ -106,21 +164,24 @@ def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
     hd = args.hidden_size // nh
 
     hin = lf.rms_norm(h, lp["ln1"], args.rms_eps)
-    q = (hin @ lp["wq"]).reshape(b, s, nh, hd)
-    k = (hin @ lp["wk"]).reshape(b, s, nkv, hd)
-    v = (hin @ lp["wv"]).reshape(b, s, nkv, hd)
+    q = _wmm(hin, lp["wq"]).reshape(b, s, nh, hd)
+    k = _wmm(hin, lp["wk"]).reshape(b, s, nkv, hd)
+    v = _wmm(hin, lp["wv"]).reshape(b, s, nkv, hd)
     q, k = lf.apply_rope(q, k, jax.lax.dynamic_slice_in_dim(cos, pos, s, 0),
                          jax.lax.dynamic_slice_in_dim(sin, pos, s, 0))
-    cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, k, pos, axis=1)
-    cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, v, pos, axis=1)
+    # cache is heads-major [b, nkv, max_len, hd]; write the new slots at pos
+    cache_k = jax.lax.dynamic_update_slice_in_dim(
+        cache_k, jnp.swapaxes(k, 1, 2), pos, axis=2)
+    cache_v = jax.lax.dynamic_update_slice_in_dim(
+        cache_v, jnp.swapaxes(v, 1, 2), pos, axis=2)
 
     attn = _cached_attention(q, cache_k, cache_v, pos)
     attn = attn.reshape(b, s, nh * hd)
-    h = h + attn @ lp["wo"]
+    h = h + _wmm(attn, lp["wo"])
 
     hin = lf.rms_norm(h, lp["ln2"], args.rms_eps)
-    act = jax.nn.silu(hin @ lp["w_gate"]) * (hin @ lp["w_up"])
-    h = h + act @ lp["w_down"]
+    act = jax.nn.silu(_wmm(hin, lp["w_gate"])) * _wmm(hin, lp["w_up"])
+    h = h + _wmm(act, lp["w_down"])
     return h, cache_k, cache_v
 
 
@@ -137,7 +198,7 @@ def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args):
     h, (new_k, new_v) = jax.lax.scan(step, h,
                                      (params["layers"], caches_k, caches_v))
     h = lf.rms_norm(h, params["final_norm"], args.rms_eps)
-    logits = h[:, -1, :] @ params["lm_head"]
+    logits = _wmm(h[:, -1, :], params["lm_head"])
     return logits.astype(jnp.float32), new_k, new_v
 
 
@@ -199,11 +260,12 @@ def _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
 
 
 def _init_cache(params, args, b, max_len):
-    """Fixed-size KV cache buffers + RoPE tables — shared by the public
-    prefill/decode_step incremental API and the compiled generate."""
+    """Fixed-size KV cache buffers [L, b, nkv, max_len, hd] + RoPE tables —
+    shared by the public prefill/decode_step incremental API and the
+    compiled generate."""
     L = lf.stack_leading_dim(params["layers"])
     hd = args.hidden_size // args.num_heads
-    ck = jnp.zeros((L, b, max_len, args.num_kv_heads, hd),
+    ck = jnp.zeros((L, b, args.num_kv_heads, max_len, hd),
                    params["embedding"].dtype)
     cv = jnp.zeros_like(ck)
     cos, sin = lf.rope_tables(max_len, hd, args.rope_theta)
@@ -212,7 +274,8 @@ def _init_cache(params, args, b, max_len):
 
 def prefill(params, args, prompt_ids, max_len):
     """Run the prompt through the model once, filling the caches.
-    Returns (next_logits [b, vocab], caches_k, caches_v)."""
+    Returns (next_logits [b, vocab], caches_k, caches_v) with caches
+    [L, b, nkv, max_len, hd]."""
     b, s = prompt_ids.shape
     ck, cv, cos, sin = _init_cache(params, args, b, max_len)
     return _forward_cached(params, prompt_ids, ck, cv, 0, cos, sin, args)
@@ -343,8 +406,10 @@ def _gpt_layer_step(lp, h, cache_k, cache_v, pos, args: GPTGenArgs):
     q = (hin @ lp["wq"] + lp["bq"]).reshape(b, s, nh, hd)
     k = (hin @ lp["wk"] + lp["bk"]).reshape(b, s, nh, hd)
     v = (hin @ lp["wv"] + lp["bv"]).reshape(b, s, nh, hd)
-    cache_k = jax.lax.dynamic_update_slice_in_dim(cache_k, k, pos, axis=1)
-    cache_v = jax.lax.dynamic_update_slice_in_dim(cache_v, v, pos, axis=1)
+    cache_k = jax.lax.dynamic_update_slice_in_dim(
+        cache_k, jnp.swapaxes(k, 1, 2), pos, axis=2)
+    cache_v = jax.lax.dynamic_update_slice_in_dim(
+        cache_v, jnp.swapaxes(v, 1, 2), pos, axis=2)
     attn = _cached_attention(q, cache_k, cache_v, pos).reshape(b, s, nh * hd)
     h = h + (attn @ lp["wo"] + lp["bo"])
 
@@ -408,7 +473,7 @@ def _gpt_generate_jit(params, args, prompt_ids, max_new_tokens, sample,
     max_len = s + max_new_tokens
     L = args.num_layers
     hd = args.hidden_size // args.num_heads
-    ck = jnp.zeros((L, b, max_len, args.num_heads, hd),
+    ck = jnp.zeros((L, b, args.num_heads, max_len, hd),
                    params["word_emb"].dtype)
     cv = jnp.zeros_like(ck)
 

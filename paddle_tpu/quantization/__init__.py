@@ -11,6 +11,8 @@ dequantizes into the bf16 MXU path (TPU has no cuBLAS-LT int8 epilogue;
 XLA fuses scale*cast into the matmul).
 """
 
+import contextlib as _contextlib
+
 import jax
 import jax.numpy as jnp
 
@@ -24,7 +26,7 @@ __all__ = [
     "quantize_linear", "dequantize_linear",
     "weight_quantize", "weight_dequantize", "weight_only_linear",
     "llm_int8_linear",
-    "apply_per_channel_scale",
+    "apply_per_channel_scale", "weight_only_int8_patched",
     "QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
 ]
 
@@ -130,11 +132,10 @@ def weight_quantize(x, algo="weight_only_int8", arch=None,
         raise NotImplementedError(
             "group-wise weight quantization (group_size > 0) is not "
             "implemented; use per-channel (group_size=-1)")
-    a = x._data
-    scale = jnp.max(jnp.abs(a), axis=0)
-    q = jnp.clip(jnp.round(a / jnp.maximum(scale, 1e-9) * 127), -127,
-                 127).astype(jnp.int8)
-    return Tensor(q), Tensor(scale.astype(jnp.float32))
+    from paddle_tpu.kernels.quantized_matmul import quantize_absmax
+
+    q, scale = quantize_absmax(x._data)
+    return Tensor(q), Tensor(scale)
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8",
@@ -152,15 +153,19 @@ def weight_dequantize(x, scale, algo="weight_only_int8",
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1,
                        name=None):
-    """x @ dequant(weight) + bias — the scale*cast fuses into the matmul."""
+    """x @ dequant(weight) + bias, routed through the fused Pallas
+    dequant-matmul on TPU (kernels/quantized_matmul): weights stream from
+    HBM as int8 and the per-channel scale is applied in-registers after the
+    MACs — the reference weight_only_linear_kernel's fusion. Off-TPU the
+    jnp composition (dequantize-then-matmul) runs instead."""
     if group_size not in (-1, None):
         raise NotImplementedError(
             "group-wise weight_only_linear is not implemented; use "
             "per-channel scales (group_size=-1)")
+    from paddle_tpu.kernels import quantized_matmul as qm
 
     def fn(a, w, s):
-        wf = w.astype(a.dtype) * (s.astype(a.dtype) / 127.0)
-        return a @ wf
+        return qm.weight_only_matmul(a, w, s, out_dtype=a.dtype)
 
     out = apply(fn, x, weight, weight_scale, _name="weight_only_linear")
     if bias is not None:
@@ -171,6 +176,110 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
 def apply_per_channel_scale(x, scales, name=None):
     return apply(lambda a, s: a * s, x, scales,
                  _name="apply_per_channel_scale")
+
+
+@_contextlib.contextmanager
+def weight_only_int8_patched(model, fused=None):
+    """Within the context, every quantizable Linear holds an int8 weight, a
+    registered per-out-channel scale parameter (state-dict key
+    `<weight key>.__scale__`), and a forward routed through the fused
+    dequant-matmul dispatch (kernels/quantized_matmul.weight_only_matmul) —
+    the export-time analogue of the reference's weight-only quant passes,
+    in the same patch idiom as ptq_int8.int8_patched. Yields the quantized
+    weight keys; float weights and forwards restore on exit.
+
+    fused: True pins the Pallas kernel into the trace (single-platform TPU
+    exports), False pins the jnp composition (portable cpu+tpu exports —
+    a Mosaic call cannot lower for cpu), None leaves backend auto-dispatch.
+    """
+    from paddle_tpu import nn
+    from paddle_tpu.kernels import quantized_matmul as qm
+    from paddle_tpu.nn.layer.layers import Parameter
+
+    def quantizable(sub):
+        w = getattr(sub, "weight", None)
+        return (isinstance(sub, nn.Linear) and w is not None
+                and w._data.ndim == 2 and min(w._data.shape) >= 16
+                and jnp.issubdtype(w._data.dtype, jnp.floating))
+
+    def make_fwd(layer, scale_param):
+        def fwd(x):
+            xd = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+            y = qm.weight_only_matmul(xd, layer.weight._data,
+                                      scale_param._data)
+            if layer.bias is not None:
+                y = y + layer.bias._data.astype(y.dtype)
+            return Tensor(y)
+
+        return fwd
+
+    # quantizing a weight mutates the shared Parameter IN PLACE, so it is
+    # only safe when EVERY module referencing that Parameter is a Linear
+    # whose forward this patch rewires — a weight tied into an Embedding
+    # (or any raw-matmul consumer) must stay float, or that consumer would
+    # silently read raw int8 codes with no scale
+    refs = {}
+    for _, sub in model.named_sublayers(include_self=True):
+        for attr, p in getattr(sub, "_parameters", {}).items():
+            if p is not None:
+                refs.setdefault(id(p), []).append((sub, attr))
+
+    def only_linear_weight_refs(w):
+        return all(isinstance(s, nn.Linear) and attr == "weight"
+                   for s, attr in refs.get(id(w), [(None, None)]))
+
+    saved, qkeys, seen = [], [], set()
+    shared_scales = {}  # id(weight Parameter) -> its scale Parameter
+    cm = (qm.fused_dispatch(enabled=fused) if fused is not None
+          else _contextlib.nullcontext())
+    try:
+        with cm:
+            for name, sub in model.named_sublayers(include_self=True):
+                if id(sub) in seen:
+                    continue  # aliased sublayers patch once
+                w = getattr(sub, "weight", None)
+                if (isinstance(sub, nn.Linear) and w is not None
+                        and id(w) in shared_scales):
+                    # a DIFFERENT Linear tied to an already-quantized
+                    # Parameter: its weight is int8 now, so it fails the
+                    # floating check — it must still get the fused forward
+                    # (sharing the owner's scale), or it would silently
+                    # compute x @ raw_int8 with no scale
+                    seen.add(id(sub))
+                    saved.append((sub, "forward" in sub.__dict__,
+                                  sub.__dict__.get("forward"), None))
+                    sub.forward = make_fwd(sub, shared_scales[id(w)])
+                    continue
+                if not quantizable(sub) or not only_linear_weight_refs(w):
+                    continue
+                seen.add(id(sub))
+                q, scale = qm.quantize_absmax(w._data)
+                saved.append((sub, "forward" in sub.__dict__,
+                              sub.__dict__.get("forward"), w._data))
+                w._data = q
+                scale_param = Parameter(scale)
+                sub.add_parameter("weight.__scale__", scale_param)
+                shared_scales[id(w)] = scale_param
+                sub.forward = make_fwd(sub, scale_param)
+                qkeys.append(f"{name}.weight" if name else "weight")
+            if not qkeys:
+                import warnings
+
+                warnings.warn(
+                    "weight_only_int8: no quantizable Linear weights found "
+                    "(only nn.Linear sublayers with 2-D float weights >= "
+                    "16 on both dims, not tied into non-Linear consumers, "
+                    "are quantized) — the export keeps full-width floats")
+            yield qkeys
+    finally:
+        for sub, had_attr, fwd, wd in saved:
+            if had_attr:
+                sub.forward = fwd
+            else:
+                sub.__dict__.pop("forward", None)
+            if wd is not None:  # None = tied alias; the owner restores
+                sub.weight._data = wd
+            sub._parameters.pop("weight.__scale__", None)
 
 
 # -- QAT / PTQ high-level API (reference quantization/config.py, qat.py) ----

@@ -91,6 +91,53 @@ class TestCallConvention:
                              False)
         np.testing.assert_allclose(out.numpy(), np.ones((4, 4)))
 
+    def test_f_suffixed_yaml_defaults_usable(self):
+        """ADVICE r5 item 1: yaml defaults like `alpha = 1.0f` must emit
+        numeric literals — calling the binding WITHOUT the arg used to
+        raise (float('1.0f') fell back to a string repr)."""
+        a = t([[1.0, -2.0]])
+        np.testing.assert_allclose(
+            _C_ops.elu(a).numpy(),
+            paddle.nn.functional.elu(a).numpy(), rtol=1e-6)
+        np.testing.assert_allclose(
+            _C_ops.leaky_relu(a).numpy(), [[1.0, -0.04]])  # yaml 0.02f
+        np.testing.assert_allclose(_C_ops.pow(a).numpy(), a.numpy())
+        np.testing.assert_allclose(
+            _C_ops.softplus(a).numpy(),
+            paddle.nn.functional.softplus(a).numpy(), rtol=1e-6)
+        import inspect
+
+        assert inspect.signature(_C_ops.stanh).parameters[
+            "scale_a"].default == 0.67
+
+    def test_dropout_forwards_is_test(self):
+        """ADVICE r5 item 2: the binding forwards is_test as
+        training=not is_test — inference-mode dropout is the identity,
+        training mode still masks."""
+        x = t(np.ones((8, 8)))
+        infer = _C_ops.dropout(x, None, 0.5, True, "upscale_in_train", 0,
+                               False)
+        np.testing.assert_array_equal(infer.numpy(), np.ones((8, 8)))
+        paddle.seed(3)
+        train = _C_ops.dropout(x, None, 0.5, False, "upscale_in_train", 0,
+                               False)
+        assert set(np.unique(train.numpy())) <= {0.0, 2.0}
+        assert (train.numpy() == 0.0).any()
+
+    def test_full_like_yaml_defaults(self):
+        """ADVICE r5 item 3: DataType::UNDEFINED lowers to None (infer from
+        input) and the legacy `place` attr is swallowed — the two-arg call
+        used to crash with "data type 'undefined' not understood"."""
+        x = t(np.zeros((2, 3)))
+        out = _C_ops.full_like(x, 3.0)
+        np.testing.assert_allclose(out.numpy(), np.full((2, 3), 3.0))
+        assert out.numpy().dtype == np.float32
+        # the other UNDEFINED-default bindings work with defaults too
+        np.testing.assert_allclose(_C_ops.ones_like(x).numpy(),
+                                   np.ones((2, 3)))
+        np.testing.assert_allclose(_C_ops.zeros_like(x).numpy(),
+                                   np.zeros((2, 3)))
+
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-x", "-q"]))

@@ -120,7 +120,7 @@ class TestGPTCompiledDecode:
         ids = np.array([[3, 17, 42, 9]], np.int32)
         eager = model(paddle.to_tensor(ids)).numpy()[:, -1, :]
         L, hd = args.num_layers, args.hidden_size // args.num_heads
-        ck = jnp.zeros((L, 1, 4, args.num_heads, hd), jnp.float32)
+        ck = jnp.zeros((L, 1, args.num_heads, 4, hd), jnp.float32)
         logits, _, _ = _gpt_forward_cached(params, ids, ck,
                                            jnp.zeros_like(ck), 0, args)
         np.testing.assert_allclose(np.asarray(logits), eager,

@@ -7,6 +7,9 @@ the parallel loss must match the single-device loss on the same params/batch.
 """
 
 import jax
+
+from paddle_tpu.distributed.mesh_utils import \
+    shard_map_compat as _shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -75,7 +78,7 @@ def test_hybrid_grads_match_single_device(dp, pp, mp, sp):
     params, _ = eng.init_state(0)
     ids, labels = _batch()
     i2, l2 = eng.shard_batch(ids, labels)
-    sm = jax.shard_map(
+    sm = _shard_map(
         eng._local_grads, mesh=eng.mesh,
         in_specs=(eng._param_specs, P(None, "dp", None), P(None, "dp", None)),
         out_specs=(P(), eng._param_specs), check_vma=True)
@@ -168,7 +171,7 @@ def test_1f1b_grads_match_single_device(dp, pp, mp, sp):
     params, _ = eng.init_state(0)
     ids, labels = _batch()
     i2, l2 = eng.shard_batch(ids, labels)
-    sm = jax.shard_map(
+    sm = _shard_map(
         eng._grads_1f1b, mesh=eng.mesh,
         in_specs=(eng._param_specs, P(None, "dp", None), P(None, "dp", None)),
         out_specs=(P(), eng._param_specs), check_vma=True)
@@ -264,7 +267,7 @@ def test_interleave_loss_and_grads_match_single_device(dp, pp, mp, sp):
     i2, l2 = eng.shard_batch(ids, labels)
     from jax.sharding import PartitionSpec as P
 
-    sm = jax.shard_map(
+    sm = _shard_map(
         eng._local_grads, mesh=eng.mesh,
         in_specs=(eng._param_specs, P(None, "dp", None), P(None, "dp", None)),
         out_specs=(P(), eng._param_specs), check_vma=True)
@@ -325,7 +328,7 @@ def test_interleave_large_m_parity():
     params, _ = eng.init_state(0)
     ids, labels = _batch(B=12)
     i2, l2 = eng.shard_batch(ids, labels)
-    sm = jax.shard_map(
+    sm = _shard_map(
         eng._local_grads, mesh=eng.mesh,
         in_specs=(eng._param_specs, P(None, "dp", None), P(None, "dp", None)),
         out_specs=(P(), eng._param_specs), check_vma=True)
@@ -408,7 +411,7 @@ def test_zero3_hybrid_loss_and_grads_parity(schedule):
     ids, labels = _batch()
     i2, l2 = eng.shard_batch(ids, labels)
     fn = eng._grads_1f1b if schedule == "1f1b" else eng._local_grads
-    sm = jax.shard_map(
+    sm = _shard_map(
         fn, mesh=eng.mesh,
         in_specs=(eng._param_specs, P(None, "dp", None), P(None, "dp", None)),
         out_specs=(P(), eng._param_specs), check_vma=True)
@@ -470,7 +473,7 @@ def test_zb_grads_match_single_device(dp, pp, mp, sp):
     params, _ = eng.init_state(0)
     ids, labels = _batch()
     i2, l2 = eng.shard_batch(ids, labels)
-    sm = jax.shard_map(
+    sm = _shard_map(
         eng._grads_zb, mesh=eng.mesh,
         in_specs=(eng._param_specs, P(None, "dp", None), P(None, "dp", None)),
         out_specs=(P(), eng._param_specs), check_vma=True)
@@ -803,7 +806,7 @@ def test_cp_grads_match_single_device():
     params, _ = eng.init_state(0)
     ids, labels = _batch()
     i2, l2 = eng.shard_batch(ids, labels)
-    sm = jax.shard_map(
+    sm = _shard_map(
         eng._local_grads, mesh=eng.mesh,
         in_specs=(eng._param_specs, P(None, "dp", "cp"),
                   P(None, "dp", "cp")),

@@ -1,0 +1,259 @@
+"""Fused int8 dequant-matmul + decode-attention kernels
+(kernels/quantized_matmul) vs their unfused XLA references — Pallas
+interpret mode on CPU, like tests/test_flash_attention.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels import quantized_matmul as qm
+
+_INTERPRET = jax.default_backend() != "tpu"
+
+
+def _quant(rng, k, n):
+    w = jnp.asarray(rng.integers(-127, 128, size=(k, n)), jnp.int8)
+    s = jnp.asarray(rng.uniform(0.5, 2.0, size=(n,)), jnp.float32)
+    return w, s
+
+
+class TestFusedDequantMatmul:
+    @pytest.mark.parametrize("m,k,n", [
+        (8, 256, 512),     # tile-aligned
+        (1, 2048, 5504),   # the decode shape (N not a multiple of 512)
+        (3, 136, 200),     # remainder on every dim
+        (17, 384, 128),    # M remainder
+        (8, 130, 640),     # K remainder only
+    ])
+    def test_matches_unfused_reference(self, m, k, n):
+        """The kernel must agree with dequantize-then-matmul across
+        (batch, in, out) tile-remainder shapes — partial blocks are masked,
+        not dropped."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(m, k)), jnp.float32)
+        w, s = _quant(rng, k, n)
+        out = qm.fused_dequant_matmul(x, w, s, interpret=_INTERPRET)
+        ref = qm._dequant_matmul_xla(x, w, s)
+        # f32 tolerance: blocked accumulation reorders the K sum
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bf16_activations_within_tolerance(self):
+        """bf16 x (the serving dtype): int8 values are exact in bf16, so
+        the kernel's f32 accumulator should be at least as accurate as the
+        unfused bf16 dequant reference."""
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(4, 256)), jnp.bfloat16)
+        w, s = _quant(rng, 256, 384)
+        out = qm.fused_dequant_matmul(x, w, s, interpret=_INTERPRET)
+        assert out.dtype == jnp.bfloat16
+        ref = jnp.asarray(x, jnp.float32) @ (
+            w.astype(jnp.float32) * s / 127.0)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), rtol=2e-2, atol=2e-1)
+
+    def test_batched_leading_dims(self):
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(2, 3, 128)), jnp.float32)
+        w, s = _quant(rng, 128, 256)
+        out = qm.fused_dequant_matmul(x, w, s, interpret=_INTERPRET)
+        assert out.shape == (2, 3, 256)
+        ref = qm._dequant_matmul_xla(x, w, s)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_dispatch_and_supports(self):
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.normal(size=(2, 64)), jnp.float32)
+        w, s = _quant(rng, 64, 96)
+        assert qm.matmul_supported(x.shape, w.shape)
+        assert not qm.matmul_supported((2, 64), (65, 96))  # K mismatch
+        # forced-off dispatch must give the jnp composition
+        with qm.fused_dispatch(enabled=False):
+            ref = qm.weight_only_matmul(x, w, s)
+        with qm.fused_dispatch(enabled=True, interpret=_INTERPRET):
+            out = qm.weight_only_matmul(x, w, s)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_weight_only_linear_routes_through_dispatch(self):
+        """The public quantization API rides the same waist (fused on TPU,
+        jnp elsewhere) and keeps its parity contract."""
+        from paddle_tpu.quantization import weight_only_linear, weight_quantize
+
+        rng = np.random.default_rng(4)
+        wf = rng.normal(size=(64, 48)).astype(np.float32)
+        x = paddle.to_tensor(rng.normal(size=(5, 64)).astype(np.float32))
+        q, s = weight_quantize(paddle.to_tensor(wf))
+        with qm.fused_dispatch(enabled=True, interpret=_INTERPRET):
+            out = weight_only_linear(x, q, weight_scale=s)
+        ref = x.numpy() @ (q.numpy().astype(np.float32)
+                           * s.numpy() / 127.0)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=2e-4, atol=2e-4)
+
+
+class TestWeightOnlyPatch:
+    def test_tied_linears_share_scale(self):
+        """Two Linears sharing ONE weight Parameter: the second must get
+        the fused forward with the owner's scale, not silently compute
+        x @ raw_int8 (the weight is already int8 when the patch reaches
+        it)."""
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import weight_only_int8_patched
+
+        class Tied(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(32, 32, bias_attr=False)
+                self.b = nn.Linear(32, 32, bias_attr=False)
+                self.b.weight = self.a.weight  # same Parameter object
+
+            def forward(self, x):
+                return self.b(self.a(x))
+
+        m = Tied()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).normal(size=(4, 32)).astype("float32"))
+        ref = m(x).numpy()
+        with weight_only_int8_patched(m) as qkeys:
+            out = m(x).numpy()
+        assert qkeys == ["a.weight"]  # quantized once
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, f"tied Linear broke quantized forward: {err:.4f}"
+        # restored cleanly
+        np.testing.assert_allclose(m(x).numpy(), ref, rtol=1e-6)
+
+    def test_weight_tied_into_non_linear_stays_float(self):
+        """A weight shared with a NON-Linear consumer (tied
+        embedding/lm_head) must not be quantized in place — the embedding
+        gather has no scale hook, so the in-place int8 codes would corrupt
+        it silently."""
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import weight_only_int8_patched
+
+        class TiedLM(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(64, 32)
+                self.head = nn.Linear(32, 64, bias_attr=False)
+                self.head.weight = self.emb.weight  # tied table
+                self.mid = nn.Linear(32, 32, bias_attr=False)
+
+            def forward(self, ids):
+                h = self.emb(ids)
+                return self.mid(h)
+
+        m = TiedLM()
+        ids = paddle.to_tensor(np.array([[1, 5, 9]], np.int64))
+        ref = m(ids).numpy()
+        with weight_only_int8_patched(m) as qkeys:
+            out = m(ids).numpy()
+            # the embedding-tied head weight must NOT be in qkeys and the
+            # embedding table must still be float
+            assert "head.weight" not in qkeys and "emb.weight" not in qkeys
+            assert qkeys == ["mid.weight"]
+            assert str(m.emb.weight._data.dtype) != "int8"
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+
+
+class TestDecodeAttention:
+    @pytest.mark.parametrize("cache_len,pos", [
+        (128, 0), (128, 64), (256, 17), (512, 511), (384, 200),
+    ])
+    def test_matches_masked_reference(self, cache_len, pos):
+        """Single-query decode attention over several cache lengths and
+        watermarks must equal full masked attention over the padded cache
+        (what _cached_attention computes at s_new=1)."""
+        rng = np.random.default_rng(pos)
+        b, nh, hd = 2, 4, 64
+        q = jnp.asarray(rng.normal(size=(b, 1, nh, hd)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(b, nh, cache_len, hd)), jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(b, nh, cache_len, hd)), jnp.float32)
+        with qm.fused_dispatch(enabled=True, interpret=_INTERPRET):
+            out = qm.decode_attention(q, ck, cv, jnp.int32(pos))
+        ref = qm._decode_attention_xla(q, ck, cv, jnp.int32(pos),
+                                       1.0 / np.sqrt(hd))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+
+    def test_gqa_native(self):
+        """nkv < nh without repeating kv heads."""
+        rng = np.random.default_rng(9)
+        b, nh, nkv, hd, cache_len = 1, 8, 2, 32, 256
+        q = jnp.asarray(rng.normal(size=(b, 1, nh, hd)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(b, nkv, cache_len, hd)), jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(b, nkv, cache_len, hd)), jnp.float32)
+        assert qm.decode_supported(q.shape, ck.shape)
+        with qm.fused_dispatch(enabled=True, interpret=_INTERPRET):
+            out = qm.decode_attention(q, ck, cv, jnp.int32(100))
+        ref = qm._decode_attention_xla(q, ck, cv, jnp.int32(100),
+                                       1.0 / np.sqrt(hd))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+
+    def test_matches_full_flash_attention(self):
+        """At s_new=1 with a fully-valid cache the decode kernel must agree
+        with causal flash attention run over the whole sequence (the kernel
+        it replaces in the decode step)."""
+        from paddle_tpu.kernels.flash_attention import _flash_attention
+
+        rng = np.random.default_rng(13)
+        b, nh, hd, seq = 1, 4, 64, 256
+        full_q = jnp.asarray(rng.normal(size=(b, seq, nh, hd)), jnp.float32)
+        full_k = jnp.asarray(rng.normal(size=(b, seq, nh, hd)), jnp.float32)
+        full_v = jnp.asarray(rng.normal(size=(b, seq, nh, hd)), jnp.float32)
+        flash = _flash_attention(full_q, full_k, full_v, True,
+                                 1.0 / np.sqrt(hd), _INTERPRET)
+        q = full_q[:, -1:].reshape(b, 1, nh, hd)
+        ck = jnp.swapaxes(full_k, 1, 2)  # [b, nh, seq, hd]
+        cv = jnp.swapaxes(full_v, 1, 2)
+        with qm.fused_dispatch(enabled=True, interpret=_INTERPRET):
+            out = qm.decode_attention(q, ck, cv, jnp.int32(seq - 1))
+        np.testing.assert_allclose(np.asarray(out[:, 0]),
+                                   np.asarray(flash[:, -1]), atol=1e-3)
+
+    def test_supports_predicate(self):
+        assert qm.decode_supported((1, 1, 8, 128), (1, 8, 256, 128))
+        assert qm.decode_supported((1, 1, 8, 128), (1, 2, 256, 128))  # GQA
+        assert not qm.decode_supported((1, 2, 8, 128), (1, 8, 256, 128))
+        assert not qm.decode_supported((1, 1, 8, 128), (1, 8, 200, 128))
+        assert not qm.decode_supported((1, 1, 6, 128), (1, 4, 256, 128))
+
+
+class TestQuantizedGenerate:
+    def test_quantized_decode_through_kernels(self):
+        """End-to-end tentpole wiring: quantize_params -> generate streams
+        int8 weights through the fused dequant-matmul AND hits the decode-
+        attention kernel (128-aligned cache), matching the jnp-dispatch
+        quantized decode exactly and the float decode on greedy tokens."""
+        from paddle_tpu.models import llama_functional as lf
+        from paddle_tpu.models.generation import generate, quantize_params
+
+        args = lf.LlamaArgs(vocab_size=128, hidden_size=64,
+                            intermediate_size=176, num_layers=2, num_heads=4,
+                            num_kv_heads=2, rope_theta=10000.0, rms_eps=1e-6,
+                            use_flash=False)
+        params = lf.init_params(args, jax.random.key(0))
+        qp = quantize_params(params)
+        assert qp["layers"]["wq"].q.dtype == jnp.int8
+        assert qp["layers"]["wq"].q.shape[0] == args.num_layers
+        ids = np.array([[5, 11, 7, 2, 9, 1, 3, 8]], np.int32)
+        # prompt 8 + 120 new = 128-aligned cache -> decode kernel engages
+        base = np.asarray(generate(params, args, ids, max_new_tokens=120))
+        q_jnp = np.asarray(generate(qp, args, ids, max_new_tokens=120))
+        with qm.fused_dispatch(enabled=True, interpret=_INTERPRET):
+            q_pallas = np.asarray(generate(qp, args, ids,
+                                           max_new_tokens=120))
+        np.testing.assert_array_equal(q_jnp, q_pallas)
+        # int8 rounding may legitimately flip late greedy ties; the head of
+        # the continuation must agree with the float model
+        np.testing.assert_array_equal(base[:, :16], q_jnp[:, :16])
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(pytest.main([__file__, "-x", "-q"]))

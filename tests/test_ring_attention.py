@@ -3,6 +3,9 @@ sequence-sharded ring must match single-device attention exactly (fwd and
 grads), causal and non-causal."""
 
 import jax
+
+from paddle_tpu.distributed.mesh_utils import \
+    shard_map_compat as _shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -27,7 +30,7 @@ def _qkv(b=2, s=64, h=4, d=16, seed=0):
 def test_ring_matches_single_device(causal, cp):
     q, k, v = _qkv()
     mesh = _mesh(cp)
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(_shard_map(
         lambda q, k, v: ring_attention(q, k, v, "cp", causal=causal),
         mesh=mesh, in_specs=(P(None, "cp"),) * 3, out_specs=P(None, "cp"),
         check_vma=True))
@@ -43,7 +46,7 @@ def test_ring_grads_match_single_device(causal):
     mesh = _mesh(4)
 
     def ring_loss(q, k, v):
-        sm = jax.shard_map(
+        sm = _shard_map(
             lambda q, k, v: ring_attention(q, k, v, "cp", causal=causal),
             mesh=mesh, in_specs=(P(None, "cp"),) * 3,
             out_specs=P(None, "cp"), check_vma=True)
@@ -66,7 +69,7 @@ def test_ring_gqa():
     k = jnp.asarray(rng.normal(size=(1, 64, 2, 16)), jnp.float32)
     v = jnp.asarray(rng.normal(size=(1, 64, 2, 16)), jnp.float32)
     mesh = _mesh(4)
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(_shard_map(
         lambda q, k, v: ring_attention(q, k, v, "cp", causal=True),
         mesh=mesh, in_specs=(P(None, "cp"),) * 3, out_specs=P(None, "cp"),
         check_vma=True))(q, k, v)
@@ -86,7 +89,7 @@ def test_ulysses_matches_single_device(causal, sp):
     """Seq-sharded all-to-all attention == dense single-device attention."""
     q, k, v = _qkv()
     mesh = Mesh(np.asarray(jax.devices()[:sp]), ("sp",))
-    uly = jax.jit(jax.shard_map(
+    uly = jax.jit(_shard_map(
         lambda q, k, v: ulysses_attention(q, k, v, "sp", causal=causal),
         mesh=mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
         check_vma=True))
@@ -101,7 +104,7 @@ def test_ulysses_grads_match_single_device():
     mesh = Mesh(np.asarray(jax.devices()[:4]), ("sp",))
 
     def uly_loss(q, k, v):
-        sm = jax.shard_map(
+        sm = _shard_map(
             lambda q, k, v: ulysses_attention(q, k, v, "sp", causal=True),
             mesh=mesh, in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"), check_vma=True)
@@ -121,7 +124,7 @@ def test_ulysses_rejects_indivisible_heads():
     q, k, v = _qkv(h=3)
     mesh = Mesh(np.asarray(jax.devices()[:2]), ("sp",))
     with pytest.raises(Exception, match="divisible"):
-        jax.jit(jax.shard_map(
+        jax.jit(_shard_map(
             lambda q, k, v: ulysses_attention(q, k, v, "sp"),
             mesh=mesh, in_specs=(P(None, "sp"),) * 3,
             out_specs=P(None, "sp"), check_vma=True))(q, k, v)

@@ -1508,6 +1508,42 @@ def test_inference_pass_framework(tmp_path):
         cfg3.pass_builder().append_pass("nonexistent_pass")
 
 
+def test_bf16_and_dedup_passes_compose(tmp_path):
+    """ADVICE r5 item 5: bf16_weights_pass + weight_dedup_pass used to
+    silently cancel — the per-element astype() created a DISTINCT bf16
+    array for each aliased entry, so the id()-keyed device_put re-split the
+    tied weights. The cast now runs through an id()-keyed memo: tied params
+    must map to the SAME device buffer with both passes on."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.static import InputSpec
+
+    class Tied(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Linear(8, 8, bias_attr=False)
+            self.b = nn.Linear(8, 8, bias_attr=False)
+            self.b.weight.set_value(self.a.weight)
+
+        def forward(self, x):
+            return self.b(self.a(x))
+
+    prefix = str(tmp_path / "tied")
+    paddle.jit.save(Tied(), prefix,
+                    input_spec=[InputSpec([2, 8], "float32", "x")])
+    cfg = Config(prefix)
+    cfg.pass_builder().append_pass("bf16_weights_pass")
+    assert "weight_dedup_pass" in cfg.pass_builder().all_passes()
+    pred = create_predictor(cfg)
+    assert all(str(p.dtype) == "bfloat16" for p in pred._params)
+    assert len({id(p) for p in pred._params}) < len(pred._params), \
+        "bf16 cast destroyed the dedup aliasing — tied weights got " \
+        "separate device buffers"
+    out = pred.run([np.ones((2, 8), np.float32)])[0]
+    assert np.isfinite(np.asarray(out)).all()
+
+
 def test_predictor_outputs_are_lazy_zero_copy(tmp_path):
     """run() must not force a host sync: outputs stay device arrays until
     read (the reference ZeroCopyTensor contract)."""

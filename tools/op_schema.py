@@ -47,6 +47,7 @@ _NAME_EQUIV = {
 # python surface, so these never count as missing. op -> arg names.
 _KERNEL_ONLY = {
     "full_": {"output", "place"},  # inplace out-var + legacy Place attr
+    "full_like": {"place"},        # legacy Place attr (as full_)
     "cumsum": {"flatten", "exclusive", "reverse"},
     "logcumsumexp": {"flatten", "exclusive", "reverse"},
     "dropout": {"seed_tensor", "is_test", "seed", "fix_seed"},
