@@ -326,6 +326,107 @@ def _bench_int8_decode(batches=(1, 4, 8), prompt=128, new_tokens=384,
     print("BENCH_DECODE " + json.dumps(out))
 
 
+def _bench_serving(seed=0):
+    """Continuous batching vs sequential generate on the SAME deterministic
+    mixed-length arrival trace (tools/serving_trace.py): tokens/sec,
+    time-to-first-token, slot occupancy, and compile counts. Sequential
+    replays the trace one request at a time through the compiled
+    `generate` (the pre-serving offline path — a new arrival waits for the
+    whole previous request); the engine admits/retires at iteration
+    granularity, so decode steps are shared across slots. Both legs are
+    warmed first (all shapes compiled), so the timed section measures
+    steady-state serving, and the engine's compile counters prove the
+    bucket policy bounds program count."""
+    import signal
+
+    def _stuck(signum, frame):
+        print("BENCH_SERVING_TIMEOUT", flush=True)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, _stuck)
+    signal.alarm(1100)
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama_functional as lf
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.serving import Engine
+    from tools.serving_trace import make_trace, trace_stats
+
+    backend = jax.default_backend()
+    if backend == "tpu":
+        from paddle_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16,
+                          max_position_embeddings=2048)
+        args = lf.LlamaArgs.from_config(cfg)
+        params = lf.init_params(args, jax.random.key(0), jnp.bfloat16)
+        slots, max_len, min_bucket = 8, 1024, 64
+        trace = make_trace(seed=seed, n_requests=24,
+                           mean_interarrival_steps=8.0,
+                           prompt_len_choices=(24, 40, 57, 96, 130, 200,
+                                               290, 410),
+                           new_tokens_choices=(128,),
+                           vocab_size=args.vocab_size)
+    else:
+        args = lf.LlamaArgs(vocab_size=512, hidden_size=128,
+                            intermediate_size=352, num_layers=2,
+                            num_heads=4, num_kv_heads=2, rope_theta=1e4,
+                            rms_eps=1e-6, use_flash=False)
+        params = lf.init_params(args, jax.random.key(0))
+        slots, max_len, min_bucket = 4, 64, 8
+        trace = make_trace(seed=seed, n_requests=16,
+                           mean_interarrival_steps=2.0,
+                           prompt_len_choices=(3, 5, 7, 9, 12, 17, 23, 31),
+                           new_tokens_choices=(16,),
+                           vocab_size=args.vocab_size)
+
+    # -- sequential generate: one request at a time, arrival order ---------
+    def run_sequential():
+        toks = 0
+        for t in trace:
+            out = np.asarray(generate(params, args, t["prompt"][None],
+                                      max_new_tokens=t["max_new_tokens"]))
+            toks += out.shape[1] - len(t["prompt"])
+        return toks
+
+    run_sequential()  # warm: compile every (prompt_len, max_new) shape
+    t0 = time.perf_counter()
+    seq_tokens = run_sequential()
+    seq_dt = time.perf_counter() - t0
+
+    # -- continuous batching over the same trace ---------------------------
+    eng = Engine(params, args, max_slots=slots, max_len=max_len,
+                 min_bucket=min_bucket)
+    eng.replay(trace)   # warm: compile every bucket + the decode program
+    eng.reset()
+    t0 = time.perf_counter()
+    reqs = eng.replay(trace)
+    srv_dt = time.perf_counter() - t0
+    srv_tokens = sum(len(r.token_ids) for r in reqs)
+
+    m = eng.metrics.summary()
+    ttft = m["observations"]["ttft_s"]
+    occ = m["observations"]["slot_occupancy"]
+    out = {
+        "backend": backend,
+        "slots": slots,
+        "max_len": max_len,
+        "trace": trace_stats(trace),
+        "serving_tokens_per_sec": round(srv_tokens / srv_dt, 1),
+        "sequential_tokens_per_sec": round(seq_tokens / seq_dt, 1),
+        "speedup": round((srv_tokens / srv_dt) / (seq_tokens / seq_dt), 3),
+        "ttft_s_mean": round(ttft["sum"] / ttft["count"], 4),
+        "ttft_s_max": round(ttft["max"], 4),
+        "slot_occupancy_mean": round(occ["sum"] / occ["count"], 3),
+        "prefill_compiles": m["counters"]["prefill_compiles"],
+        "decode_compiles": m["counters"]["decode_compiles"],
+    }
+    print("BENCH_SERVING " + json.dumps(out))
+
+
 def main():
     # the axon tunnel blocks indefinitely while another (possibly dead)
     # claimant wedges the claim; emit a diagnostic line instead of hanging
@@ -466,6 +567,24 @@ def main():
         except subprocess.TimeoutExpired:
             print("int8 decode bench timed out", file=sys.stderr)
 
+        # continuous-batching serving leg (r7 tentpole): engine vs
+        # sequential generate on the deterministic mixed-length trace
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--serving"],
+                capture_output=True, text=True, timeout=1500,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            for line in out.stdout.splitlines():
+                if line.startswith("BENCH_SERVING "):
+                    record["serving"] = json.loads(
+                        line[len("BENCH_SERVING "):])
+                    break
+            else:
+                print(f"serving bench failed:\n{out.stderr[-2000:]}",
+                      file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print("serving bench timed out", file=sys.stderr)
+
     print(json.dumps(record))
     return 0
 
@@ -477,5 +596,7 @@ if __name__ == "__main__":
         _bench_int8()
     elif len(sys.argv) == 2 and sys.argv[1] == "--int8-decode":
         _bench_int8_decode()
+    elif len(sys.argv) == 2 and sys.argv[1] == "--serving":
+        _bench_serving()
     else:
         sys.exit(main())

@@ -82,7 +82,7 @@ def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
 _LAZY_SUBMODULES = ("distributed", "inference", "static", "profiler",
                     "incubate", "sparse", "linalg", "fft", "signal",
                     "geometric", "distribution", "quantization", "text",
-                    "device", "dataset", "audio")
+                    "device", "dataset", "audio", "serving")
 
 
 def __getattr__(name):

@@ -63,6 +63,7 @@ class Config:
         self._precision = PrecisionType.Float32
         self._enable_memory_optim = True
         self._cpu_math_threads = 1
+        self._profile = False
 
     # -- device selection ---------------------------------------------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
@@ -114,9 +115,12 @@ class Config:
                         "startup)")
 
     def enable_profile(self):
-        self._noop_warn("enable_profile",
-                        "use paddle_tpu.profiler.Profiler around run() "
-                        "instead")
+        """Turn on run-level profiling: the Predictor records wall time and
+        call counts into a serving.metrics registry, retrievable via
+        `Predictor.summary()`. (Profiled runs block on the outputs so the
+        recorded wall time covers device execution, trading away the
+        ZeroCopy async-dispatch pipelining.)"""
+        self._profile = True
 
     def glog_info_disabled(self):
         return True
@@ -252,6 +256,12 @@ class Predictor:
         self._input_dtypes = [
             a.dtype for a in self._exported.in_avals[-len(self._input_names):]
         ] if self._input_names else []
+        if getattr(config, "_profile", False):
+            from paddle_tpu.serving.metrics import Metrics
+
+            self._profile_metrics = Metrics()
+        else:
+            self._profile_metrics = None
 
     def _apply_passes(self, config, params):
         """Run the load-time analysis passes (reference
@@ -336,7 +346,16 @@ class Predictor:
             navals = self._exported.in_avals[:len(params)]
             params = [p.astype(av.dtype) if p.dtype != av.dtype else p
                       for p, av in zip(params, navals)]
-        out = self._exported.call(*params, *feeds)
+        if self._profile_metrics is not None:
+            import jax
+
+            with self._profile_metrics.timer("run_wall_s"):
+                out = self._exported.call(*params, *feeds)
+                # block so the recorded wall time includes device execution
+                jax.block_until_ready(out)
+            self._profile_metrics.inc("run_calls")
+        else:
+            out = self._exported.call(*params, *feeds)
         outs = list(out) if isinstance(out, (list, tuple)) else [out]
         if len(outs) != len(self._output_names):
             # older saves lacked output_names; never drop outputs
@@ -347,6 +366,14 @@ class Predictor:
             self._outputs[name]._array = o
             results.append(o)
         return results
+
+    def summary(self):
+        """Profile summary when `Config.enable_profile()` was set: wall-time
+        observation (count/sum/mean/min/max seconds) + run_calls counter
+        from the serving metrics layer. None when profiling is off."""
+        if self._profile_metrics is None:
+            return None
+        return self._profile_metrics.summary()
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -240,8 +240,9 @@ def weight_only_matmul(x, w, scale, out_dtype=None):
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale):
     # blocks: q/o [1, 1, g, d] (the g query heads sharing this kv head);
-    # k/v [1, 1, max_len, d]; pos is scalar-prefetched
-    pos = pos_ref[0]
+    # k/v [1, 1, max_len, d]; pos is scalar-prefetched PER ROW [b] — the
+    # serving decode step has every slot at its own sequence position
+    pos = pos_ref[pl.program_id(0)]
     q = q_ref[0, 0]  # [g, d]
     g, d = q.shape
 
@@ -298,7 +299,8 @@ def _decode_attention_pallas(q, cache_k, cache_v, pos, sm_scale, block_k,
     g = nh // nkv
     bk = _pick_block(max_len, min(block_k, max_len))
     q4 = q[:, 0].reshape(b, nkv, g, hd)
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    # scalar pos broadcasts to the per-row form the kernel reads
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -333,6 +335,8 @@ def _decode_attention_xla(q, cache_k, cache_v, pos, sm_scale):
     qh = jnp.swapaxes(q, 1, 2)  # [b, nh, 1, hd]
     scores = jnp.einsum("bhqd,bhkd->bhqk", qh, cache_k) * sm_scale
     key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, max_len), 3)
+    if jnp.ndim(pos) == 1:  # per-row valid prefixes [b]
+        pos = jnp.asarray(pos).reshape(b, 1, 1, 1)
     scores = jnp.where(key_pos <= pos, scores, _NEG_INF)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(cache_v.dtype), cache_v)
@@ -343,7 +347,9 @@ def decode_attention(q, cache_k, cache_v, pos, scale=None, block_k=512):
     """Single-query attention of q [b, 1, nh, hd] over the fixed-size cache
     [b, nkv, max_len, hd], valid prefix [0, pos] (pos is the traced write
     position of q's own k/v — the decode step of the compiled generate).
-    GQA native: kv heads are never repeated."""
+    pos may be a scalar (uniform batch) or an int32 [b] vector — per-row
+    positions, the continuous-batching decode step where every slot sits at
+    its own sequence depth. GQA native: kv heads are never repeated."""
     sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     use_pallas, interpret = _mode()
     if use_pallas and decode_supported(q.shape, cache_k.shape,

@@ -125,7 +125,11 @@ def _cached_attention(q, cache_k, cache_v, pos):
     every step). Shared by the Llama and GPT decode layers. The decode step
     (s == 1) dispatches to the Pallas decode-attention kernel when
     supported: single query against the cache, online max/sum bounded to
-    the valid prefix, GQA without repeating kv heads."""
+    the valid prefix, GQA without repeating kv heads.
+
+    pos: scalar (every row at the same depth — the compiled generate), or
+    an int32 [b] vector of per-row positions (continuous-batching decode:
+    each slot at its own depth; requires s == 1)."""
     b, s, nh, hd = q.shape
     nkv, max_len = cache_k.shape[1], cache_k.shape[2]
     if s == 1:
@@ -143,12 +147,24 @@ def _cached_attention(q, cache_k, cache_v, pos):
     qh = jnp.swapaxes(q, 1, 2)
     scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(hd)
     key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len), 3)
-    query_pos = pos + jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len),
-                                               2)
+    if jnp.ndim(pos) == 1:
+        query_pos = jnp.asarray(pos).reshape(b, 1, 1, 1)  # s == 1 per row
+    else:
+        query_pos = pos + jax.lax.broadcasted_iota(
+            jnp.int32, (1, 1, s, max_len), 2)
     scores = jnp.where(key_pos <= query_pos, scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vh.dtype), vh)
     return jnp.swapaxes(attn, 1, 2)
+
+
+def _rope_rows(q, k, cos_r, sin_r):
+    """RoPE at per-row positions: q/k [b, 1, nh, hd], cos_r/sin_r [b, hd]
+    (the rows of the RoPE tables gathered at each row's own position) —
+    the same rotate-half math as lf.apply_rope, broadcast over batch
+    instead of sequence."""
+    return lf.apply_rope_bcast(q, k, cos_r[:, None, None, :],
+                               sin_r[:, None, None, :])
 
 
 def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
@@ -157,7 +173,11 @@ def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
     prefill (pos == 0, s == prompt len): causal attention within the
     block, cache slots [0, s) written. decode (s == 1): attend over
     cache[: pos+1] via masking, slot [pos] written. Both are the same
-    masking rule: key_pos <= pos + query_row."""
+    masking rule: key_pos <= pos + query_row.
+
+    pos may be an int32 [b] vector (requires s == 1): every row sits at its
+    own position — per-row RoPE, per-row cache-slot writes, per-row
+    attention masking. This is the continuous-batching decode step."""
     b, s = h.shape[0], h.shape[1]
     nh = args.num_heads
     nkv = args.num_kv_heads
@@ -167,13 +187,29 @@ def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
     q = _wmm(hin, lp["wq"]).reshape(b, s, nh, hd)
     k = _wmm(hin, lp["wk"]).reshape(b, s, nkv, hd)
     v = _wmm(hin, lp["wv"]).reshape(b, s, nkv, hd)
-    q, k = lf.apply_rope(q, k, jax.lax.dynamic_slice_in_dim(cos, pos, s, 0),
-                         jax.lax.dynamic_slice_in_dim(sin, pos, s, 0))
-    # cache is heads-major [b, nkv, max_len, hd]; write the new slots at pos
-    cache_k = jax.lax.dynamic_update_slice_in_dim(
-        cache_k, jnp.swapaxes(k, 1, 2), pos, axis=2)
-    cache_v = jax.lax.dynamic_update_slice_in_dim(
-        cache_v, jnp.swapaxes(v, 1, 2), pos, axis=2)
+    if jnp.ndim(pos) == 1:
+        if s != 1:
+            raise ValueError("per-row pos vector requires s == 1 "
+                             f"(got s={s})")
+        q, k = _rope_rows(q, k, jnp.take(cos, pos, axis=0),
+                          jnp.take(sin, pos, axis=0))
+
+        # cache [b, nkv, max_len, hd]: each row's new kv lands at that
+        # row's own position
+        def write_row(c, new, p):
+            return jax.lax.dynamic_update_slice_in_dim(c, new, p, axis=1)
+
+        cache_k = jax.vmap(write_row)(cache_k, jnp.swapaxes(k, 1, 2), pos)
+        cache_v = jax.vmap(write_row)(cache_v, jnp.swapaxes(v, 1, 2), pos)
+    else:
+        q, k = lf.apply_rope(q, k,
+                             jax.lax.dynamic_slice_in_dim(cos, pos, s, 0),
+                             jax.lax.dynamic_slice_in_dim(sin, pos, s, 0))
+        # cache is heads-major [b, nkv, max_len, hd]; write new slots at pos
+        cache_k = jax.lax.dynamic_update_slice_in_dim(
+            cache_k, jnp.swapaxes(k, 1, 2), pos, axis=2)
+        cache_v = jax.lax.dynamic_update_slice_in_dim(
+            cache_v, jnp.swapaxes(v, 1, 2), pos, axis=2)
 
     attn = _cached_attention(q, cache_k, cache_v, pos)
     attn = attn.reshape(b, s, nh * hd)
@@ -185,8 +221,14 @@ def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
     return h, cache_k, cache_v
 
 
-def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args):
-    """ids [b, s] -> (next-token logits [b, vocab], new caches)."""
+def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args,
+                    last_idx=None):
+    """ids [b, s] -> (next-token logits [b, vocab], new caches).
+
+    last_idx: optional traced per-row (or scalar) index of the LAST REAL
+    token in each row — serving prefills pad prompts up to a length bucket,
+    so the next-token logits live at true_len-1, not at s-1. None keeps the
+    plain h[:, -1] gather."""
     h = jnp.take(params["embedding"], ids, axis=0)
 
     def step(carry, xs):
@@ -198,7 +240,13 @@ def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args):
     h, (new_k, new_v) = jax.lax.scan(step, h,
                                      (params["layers"], caches_k, caches_v))
     h = lf.rms_norm(h, params["final_norm"], args.rms_eps)
-    logits = _wmm(h[:, -1, :], params["lm_head"])
+    if last_idx is None:
+        hl = h[:, -1, :]
+    else:
+        idx = jnp.broadcast_to(jnp.asarray(last_idx, jnp.int32).reshape(-1),
+                               (h.shape[0],))
+        hl = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0, :]
+    logits = _wmm(hl, params["lm_head"])
     return logits.astype(jnp.float32), new_k, new_v
 
 
@@ -282,9 +330,19 @@ def prefill(params, args, prompt_ids, max_len):
 
 
 def decode_step(params, args, token, caches_k, caches_v, pos, max_len):
-    """One incremental step: token [b] at position pos."""
+    """One incremental step: token [b] at position pos.
+
+    pos: scalar (uniform batch — every row at the same depth), or an int32
+    [b] vector of PER-ROW positions: each row attends its own valid prefix
+    [0, pos[i]] and writes its kv at pos[i]. The vector form is the
+    continuous-batching decode step (paddle_tpu.serving): slots admitted at
+    different times sit at different sequence depths inside one batched
+    program. Rows are independent — an inactive/garbage slot cannot perturb
+    the others."""
     hd = args.hidden_size // args.num_heads
     cos, sin = lf.rope_tables(max_len, hd, args.rope_theta)
+    if jnp.ndim(pos) == 1:
+        pos = jnp.asarray(pos, jnp.int32)
     return _forward_cached(params, token[:, None], caches_k, caches_v, pos,
                            cos, sin, args)
 

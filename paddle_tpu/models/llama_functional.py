@@ -122,16 +122,24 @@ def rope_tables(seq_len, head_dim, theta):
     return jnp.cos(emb), jnp.sin(emb)
 
 
-def apply_rope(q, k, cos, sin):
+def apply_rope_bcast(q, k, c, s):
+    """RoPE with cos/sin ALREADY broadcast to q/k's rank — the one
+    rotate-half implementation behind both the sequence-major path
+    (apply_rope) and the per-row serving decode path (each batch row at
+    its own position; generation._layer_step)."""
     def rot(x):
         x1, x2 = jnp.split(x, 2, axis=-1)
         return jnp.concatenate([-x2, x1], axis=-1)
 
     dt = q.dtype
     q32, k32 = q.astype(jnp.float32), k.astype(jnp.float32)
-    c, s = cos[None, :, None, :], sin[None, :, None, :]
     return ((q32 * c + rot(q32) * s).astype(dt),
             (k32 * c + rot(k32) * s).astype(dt))
+
+
+def apply_rope(q, k, cos, sin):
+    return apply_rope_bcast(q, k, cos[None, :, None, :],
+                            sin[None, :, None, :])
 
 
 def _attention(q, k, v, use_flash):

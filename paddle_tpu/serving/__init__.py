@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — continuous-batching LLM serving.
+
+`Engine` schedules requests at iteration granularity over a slot-based
+KV cache (`serving/engine.py`); `serving/scheduler.py` holds the
+admission queue / length buckets / slot table; `serving/metrics.py` the
+counters (queue depth, TTFT, tokens/sec, slot occupancy, compile counts)
+that also back `inference.Config.enable_profile()`.
+
+    from paddle_tpu.serving import Engine, Request
+
+    eng = Engine(params, args, max_slots=8, max_len=512)
+    req = eng.submit(Request(prompt_ids, max_new_tokens=64,
+                             eos_token_id=2, stream_cb=on_token))
+    eng.run_until_idle()          # req.token_ids, req.ttft_s, ...
+    print(eng.metrics.summary())
+
+`bench.py --serving` replays a deterministic Poisson-ish arrival trace
+(`tools/serving_trace.py`) and reports throughput + TTFT against
+sequential `generate`.
+"""
+
+from paddle_tpu.serving.engine import Engine, Request
+from paddle_tpu.serving.metrics import Metrics
+from paddle_tpu.serving.scheduler import (AdmissionQueue, SlotTable,
+                                          bucket_for)
+
+__all__ = ["Engine", "Request", "Metrics", "AdmissionQueue", "SlotTable",
+           "bucket_for"]

@@ -1,0 +1,318 @@
+"""Continuous-batching LLM serving engine with a slot-based KV cache.
+
+The offline `models/generation.generate` path decodes a FIXED batch: one
+straggler holds every row, finished rows burn decode FLOPs emitting pads,
+and new requests wait for the whole batch to drain. This engine applies
+iteration-level scheduling (Orca, OSDI'22) over the slot/block-managed
+cache idea (vLLM's PagedAttention, SOSP'23), assembled from the PR-1
+decode machinery:
+
+  - ONE fixed KV cache `[L, S, nkv, max_len, hd]` (heads-major, the
+    layout the Pallas decode-attention kernel consumes) where the batch
+    axis is S SLOTS, each owned by at most one in-flight request;
+  - every `step()` either PREFILLS the next queued request into a free
+    slot (prompt right-padded to a power-of-two length bucket —
+    compilation stays bounded at #buckets prefill programs; the
+    next-token logits are gathered at the request's true last token) or
+    runs ONE batched decode step across all S slots with a PER-ROW
+    position vector (`models/generation.decode_step`'s pos-vector form:
+    per-row RoPE, per-row cache writes, per-row valid-prefix masking in
+    both the jnp fallback and the Pallas decode kernel);
+  - rows that emit their EOS (or hit max_new_tokens) RETIRE immediately:
+    the slot returns to the table and the next waiting request is
+    admitted on a later step — no drain barrier. Slot caches are never
+    cleared: a prefill rewrites the whole slot, and decode's
+    write-before-attend order means stale tail positions are always
+    overwritten before the position mask ever exposes them.
+
+Greedy decoding (the scheduler retires rows on exact token identity, so
+continuous-batched output is token-for-token identical to sequential
+`generate` — tested). Weight-only int8 trees from
+`generation.quantize_params` serve unchanged: every matmul inside the
+traced step streams through the fused dequant-matmul dispatch.
+
+Host/device split: the scheduler (queue, slot table, retire/admit,
+streaming callbacks, wall-clock metrics) runs in Python between steps;
+the two traced programs (per-bucket prefill, one decode) contain no
+wall-clock reads and re-compile only when a NEW bucket shape arrives —
+compile counts are metered at trace time (`serving/metrics.py`).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models import generation as gen
+from paddle_tpu.models import llama_functional as lf
+from paddle_tpu.serving.metrics import Metrics
+from paddle_tpu.serving.scheduler import AdmissionQueue, SlotTable, bucket_for
+
+__all__ = ["Request", "Engine"]
+
+_req_ids = itertools.count()
+
+
+class Request:
+    """One generation request.
+
+    stream_cb(request, token_id, finished) fires once per generated token,
+    in emission order, from the host scheduler (never inside traced code).
+    After completion: `token_ids` (generated tokens, incl. the EOS if one
+    was emitted), `finish_reason` ('eos' | 'length'), `ttft_s`.
+    """
+
+    def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
+                 stream_cb=None, request_id=None):
+        self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if self.prompt_ids.size == 0:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_token_id = (None if eos_token_id is None
+                             else int(eos_token_id))
+        self.stream_cb = stream_cb
+        self.request_id = (next(_req_ids) if request_id is None
+                           else request_id)
+        self.token_ids = []
+        self.finished = False
+        self.finish_reason = None
+        self.submit_time = None
+        self.first_token_time = None
+        self.finish_time = None
+        self.ttft_s = None
+
+    def output_ids(self):
+        """prompt + generated tokens (the sequential-generate row shape,
+        minus its trailing pads)."""
+        return np.concatenate(
+            [self.prompt_ids, np.asarray(self.token_ids, np.int32)])
+
+
+def _prefill_traced(params, ids, true_len, ck, cv, slot, cos, sin, *,
+                    args, metrics):
+    # runs once per COMPILE (trace time), not per call — see metrics.py
+    metrics.inc("prefill_compiles")
+    L = ck.shape[0]
+    sck = jnp.zeros((L, 1) + ck.shape[2:], ck.dtype)
+    scv = jnp.zeros_like(sck)
+    logits, sck, scv = gen._forward_cached(
+        params, ids, sck, scv, 0, cos, sin, args, last_idx=true_len - 1)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+    ck = jax.lax.dynamic_update_slice_in_dim(ck, sck, slot, axis=1)
+    cv = jax.lax.dynamic_update_slice_in_dim(cv, scv, slot, axis=1)
+    return ck, cv, first
+
+
+def _decode_traced(params, tokens, ck, cv, pos, cos, sin, *, args, metrics):
+    metrics.inc("decode_compiles")
+    logits, ck, cv = gen._forward_cached(
+        params, tokens[:, None], ck, cv, pos, cos, sin, args)
+    return ck, cv, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+class Engine:
+    """Continuous-batching serving engine over a Llama functional param
+    tree (float or `quantize_params` int8).
+
+    max_slots: S — concurrent in-flight requests (the decode batch).
+    max_len:   per-slot KV capacity; prompt_len + max_new_tokens must stay
+               within it. On TPU pick a multiple of 128 so the Pallas
+               decode-attention fast path stays eligible.
+    min_bucket: smallest prefill length bucket (power-of-two ladder up to
+               max_len).
+    """
+
+    def __init__(self, params, args, *, max_slots=4, max_len=256,
+                 min_bucket=16, pad_id=0, metrics=None):
+        self.params = params
+        self.args = args
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.min_bucket = int(min_bucket)
+        self.pad_id = int(pad_id)
+        self.metrics = metrics if metrics is not None else Metrics()
+
+        L = lf.stack_leading_dim(params["layers"])
+        hd = args.hidden_size // args.num_heads
+        cache_dtype = params["embedding"].dtype
+        self._ck = jnp.zeros(
+            (L, self.max_slots, args.num_kv_heads, self.max_len, hd),
+            cache_dtype)
+        self._cv = jnp.zeros_like(self._ck)
+        self._cos, self._sin = lf.rope_tables(self.max_len, hd,
+                                              args.rope_theta)
+
+        self.queue = AdmissionQueue(self.metrics)
+        self.slots = SlotTable(self.max_slots)
+        self._npos = np.zeros(self.max_slots, np.int32)   # next write pos
+        self._last_tok = np.full(self.max_slots, self.pad_id, np.int32)
+        self.step_count = 0
+
+        # donate the KV cache buffers: the engine threads ck/cv through
+        # every step and immediately drops the old arrays, so XLA aliases
+        # input to output instead of materializing a fresh cache copy per
+        # step (on the TPU bench shape that copy is ~1 GB/step). CPU/other
+        # backends don't implement donation — skip it there to avoid a
+        # warning per compile.
+        donate = jax.default_backend() == "tpu"
+        self._prefill = jax.jit(
+            functools.partial(_prefill_traced, args=args,
+                              metrics=self.metrics),
+            donate_argnums=(3, 4) if donate else ())
+        self._decode = jax.jit(
+            functools.partial(_decode_traced, args=args,
+                              metrics=self.metrics),
+            donate_argnums=(2, 3) if donate else ())
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, req):
+        """Queue a Request (or raw prompt ids). Returns the Request."""
+        if not isinstance(req, Request):
+            req = Request(req)
+        n = int(req.prompt_ids.size)
+        bucket_for(n, self.min_bucket, self.max_len)  # length must fit
+        if n + req.max_new_tokens > self.max_len + 1:
+            raise ValueError(
+                f"request needs {n} prompt + {req.max_new_tokens} new "
+                f"tokens but the slot capacity is max_len={self.max_len}")
+        req.submit_time = time.perf_counter()
+        self.queue.push(req)
+        self.metrics.inc("requests_submitted")
+        return req
+
+    # -- the iteration-level scheduler --------------------------------------
+    def step(self):
+        """One engine iteration: admit-and-prefill if a request is waiting
+        and a slot is free, else one batched decode step over all active
+        slots, else idle. Returns a small event dict."""
+        if self.queue and self.slots.free_count:
+            ev = self._prefill_step()
+        elif self.slots.active_slots:
+            ev = self._decode_step()
+        else:
+            ev = {"type": "idle"}
+        self.step_count += 1
+        self.metrics.observe("slot_occupancy", self.slots.occupancy())
+        return ev
+
+    def run_until_idle(self):
+        """Drive step() until every queued/active request completes."""
+        while self.queue or self.slots.active_slots:
+            self.step()
+
+    def serve(self, requests):
+        """Convenience: submit all, run to completion, return them."""
+        reqs = [self.submit(r) for r in requests]
+        self.run_until_idle()
+        return reqs
+
+    def replay(self, trace):
+        """Replay an arrival trace (tools/serving_trace.py): each entry
+        {'arrival_step', 'prompt', 'max_new_tokens'[, 'eos_token_id']} is
+        submitted once the engine reaches its arrival step; idle steps
+        advance virtual time between sparse arrivals. Returns Requests in
+        trace order."""
+        pending = sorted(trace, key=lambda t: t["arrival_step"])
+        out = {}
+        i = 0
+        while i < len(pending) or self.queue or self.slots.active_slots:
+            while (i < len(pending)
+                   and pending[i]["arrival_step"] <= self.step_count):
+                t = pending[i]
+                req = Request(t["prompt"], t["max_new_tokens"],
+                              eos_token_id=t.get("eos_token_id"),
+                              request_id=t.get("request_id"))
+                out[id(t)] = self.submit(req)
+                i += 1
+            self.step()
+        return [out[id(t)] for t in trace]
+
+    def reset(self):
+        """Forget all requests/slots (keeps compiled programs AND compile
+        counters; per-run metrics are cleared) — benchmark warmup then
+        timed replay on one engine without recompiling."""
+        if self.queue or self.slots.active_slots:
+            raise RuntimeError("reset() with requests still in flight")
+        self.metrics.reset(keep_counters=("prefill_compiles",
+                                          "decode_compiles"))
+        self.queue = AdmissionQueue(self.metrics)
+        self.slots = SlotTable(self.max_slots)
+        self._npos[:] = 0
+        self._last_tok[:] = self.pad_id
+        self.step_count = 0
+
+    # -- internals ----------------------------------------------------------
+    def _prefill_step(self):
+        req = self.queue.pop()
+        slot = self.slots.admit(req)
+        n = int(req.prompt_ids.size)
+        bucket = bucket_for(n, self.min_bucket, self.max_len)
+        padded = np.full((1, bucket), self.pad_id, np.int32)
+        padded[0, :n] = req.prompt_ids
+        with self.metrics.timer("prefill_s"):
+            self._ck, self._cv, first = self._prefill(
+                self.params, jnp.asarray(padded), jnp.int32(n),
+                self._ck, self._cv, jnp.int32(slot), self._cos, self._sin)
+            first = int(first)
+        now = time.perf_counter()
+        req.first_token_time = now
+        req.ttft_s = now - req.submit_time
+        self.metrics.observe("ttft_s", req.ttft_s)
+        self.metrics.inc("prefills")
+        self.metrics.inc("tokens_generated")
+        self._npos[slot] = n
+        self._last_tok[slot] = first
+        self._emit(req, first)
+        if req.finished:
+            self._retire(slot)
+        return {"type": "prefill", "request_id": req.request_id,
+                "slot": slot, "bucket": bucket, "token": first}
+
+    def _decode_step(self):
+        active = self.slots.active_slots
+        with self.metrics.timer("decode_step_s"):
+            self._ck, self._cv, nxt = self._decode(
+                self.params, jnp.asarray(self._last_tok), self._ck,
+                self._cv, jnp.asarray(self._npos), self._cos, self._sin)
+            nxt = np.asarray(nxt)
+        emitted = {}
+        for slot in active:
+            self._npos[slot] += 1
+            tok = int(nxt[slot])
+            self._last_tok[slot] = tok
+            req = self.slots.owner(slot)
+            self._emit(req, tok)
+            emitted[req.request_id] = tok
+            if req.finished:
+                self._retire(slot)
+        self.metrics.inc("decode_steps")
+        self.metrics.inc("tokens_generated", len(active))
+        self.metrics.observe("tokens_per_decode_step", len(active))
+        return {"type": "decode", "tokens": emitted}
+
+    def _emit(self, req, token):
+        req.token_ids.append(token)
+        finished, reason = False, None
+        if req.eos_token_id is not None and token == req.eos_token_id:
+            finished, reason = True, "eos"
+        elif len(req.token_ids) >= req.max_new_tokens:
+            finished, reason = True, "length"
+        if req.stream_cb is not None:
+            req.stream_cb(req, token, finished)
+        if finished:
+            req.finished = True
+            req.finish_reason = reason
+            req.finish_time = time.perf_counter()
+            self.metrics.inc("requests_finished")
+
+    def _retire(self, slot):
+        self.slots.retire(slot)
+        self._npos[slot] = 0
+        self._last_tok[slot] = self.pad_id

@@ -1,0 +1,97 @@
+"""Serving metrics: counters / gauges / observations for the engine and
+the inference Predictor.
+
+The reference ships a GPU-serving metrics layer in PaddleNLP's serving
+stack (queue depth, first-token latency, QPS); here one small dependency-
+free registry backs three consumers:
+
+  - `serving.Engine` — queue depth, slot occupancy, per-step tokens/sec,
+    time-to-first-token, and COMPILE COUNTS (incremented at trace time:
+    the jitted step bodies bump a counter as a Python side effect, which
+    runs exactly once per XLA compilation — a cached call never re-enters
+    the traced Python, so the counter is precisely "programs built");
+  - `inference.Config.enable_profile()` — Predictor.run wall time + call
+    counts, retrievable via `Predictor.summary()`;
+  - `bench.py --serving` — the throughput/TTFT artifact.
+
+Nothing here runs inside traced code except the trace-time counter bumps;
+no wall-clock reads ever enter a jitted program.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+__all__ = ["Metrics"]
+
+
+class Metrics:
+    """Counters (monotonic), gauges (last value + max), observations
+    (count/sum/min/max streaming summaries)."""
+
+    def __init__(self):
+        self._counters = {}
+        self._gauges = {}
+        self._obs = {}
+
+    # -- counters -----------------------------------------------------------
+    def inc(self, name, value=1):
+        self._counters[name] = self._counters.get(name, 0) + value
+
+    def counter(self, name):
+        return self._counters.get(name, 0)
+
+    # -- gauges -------------------------------------------------------------
+    def set_gauge(self, name, value):
+        g = self._gauges.setdefault(name, {"value": 0, "max": value})
+        g["value"] = value
+        g["max"] = max(g["max"], value)
+
+    def gauge(self, name):
+        g = self._gauges.get(name)
+        return g["value"] if g else 0
+
+    # -- observations -------------------------------------------------------
+    def observe(self, name, value):
+        value = float(value)
+        o = self._obs.get(name)
+        if o is None:
+            self._obs[name] = {"count": 1, "sum": value, "min": value,
+                               "max": value}
+        else:
+            o["count"] += 1
+            o["sum"] += value
+            o["min"] = min(o["min"], value)
+            o["max"] = max(o["max"], value)
+
+    def observation(self, name):
+        o = self._obs.get(name)
+        if not o:
+            return None
+        return dict(o, mean=o["sum"] / o["count"])
+
+    @contextlib.contextmanager
+    def timer(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    # -- reporting ----------------------------------------------------------
+    def summary(self):
+        return {
+            "counters": dict(self._counters),
+            "gauges": {k: dict(v) for k, v in self._gauges.items()},
+            "observations": {k: self.observation(k) for k in self._obs},
+        }
+
+    def reset(self, keep_counters=()):
+        """Clear everything except the named counters — the engine's
+        compile counters survive a reset so warmup + timed benchmark runs
+        on one engine still report honest compile totals."""
+        kept = {k: v for k, v in self._counters.items() if k in keep_counters}
+        self._counters = kept
+        self._gauges = {}
+        self._obs = {}

@@ -1,0 +1,104 @@
+"""Admission queue, prompt-length buckets, and the slot table.
+
+Iteration-level scheduling (Orca, OSDI'22) needs three small host-side
+pieces the engine composes every step:
+
+  - `bucket_for`: prompts prefill at the next power-of-two length, so an
+    arbitrary-length traffic mix compiles at most log2(max_len) prefill
+    programs — compilation stays BOUNDED no matter what lengths arrive
+    (the XLA analogue of vLLM's fixed block size: shape variety, not
+    memory, is the scarce resource on TPU).
+  - `AdmissionQueue`: FIFO of waiting requests; depth is exported as a
+    gauge so saturation is visible.
+  - `SlotTable`: S cache slots; admit() hands the lowest free slot to a
+    request, retire() frees it for the next waiting request (the slot's
+    KV range is NOT cleared — a prefill rewrites [0, bucket) and the
+    write-before-attend decode order means stale tail positions are
+    always overwritten before they are ever unmasked).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+__all__ = ["bucket_for", "AdmissionQueue", "SlotTable"]
+
+
+def bucket_for(n, min_bucket=16, max_bucket=None):
+    """Smallest power-of-two >= n (floored at min_bucket, capped at
+    max_bucket). One prefill program compiles per distinct bucket."""
+    if n < 1:
+        raise ValueError(f"bucket_for: need a non-empty prompt (n={n})")
+    b = max(int(min_bucket), 1)
+    while b < n:
+        b *= 2
+    if max_bucket is not None:
+        if n > max_bucket:
+            raise ValueError(
+                f"prompt length {n} exceeds the largest bucket {max_bucket}")
+        b = min(b, int(max_bucket))
+    return b
+
+
+class AdmissionQueue:
+    """FIFO admission queue. Every mutation refreshes the queue-depth
+    gauge on the shared metrics registry."""
+
+    def __init__(self, metrics=None):
+        self._q = deque()
+        self._metrics = metrics
+
+    def _gauge(self):
+        if self._metrics is not None:
+            self._metrics.set_gauge("queue_depth", len(self._q))
+
+    def push(self, req):
+        self._q.append(req)
+        self._gauge()
+
+    def pop(self):
+        req = self._q.popleft()
+        self._gauge()
+        return req
+
+    def __len__(self):
+        return len(self._q)
+
+    def __bool__(self):
+        return bool(self._q)
+
+
+class SlotTable:
+    """S KV-cache slots; tracks which request owns which slot."""
+
+    def __init__(self, n_slots):
+        if n_slots < 1:
+            raise ValueError("need at least one slot")
+        self.n_slots = n_slots
+        self._free = list(range(n_slots - 1, -1, -1))  # pop() -> lowest slot
+        self._owner = {}
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    @property
+    def active_slots(self):
+        return sorted(self._owner)
+
+    def owner(self, slot):
+        return self._owner[slot]
+
+    def admit(self, req):
+        slot = self._free.pop()
+        self._owner[slot] = req
+        return slot
+
+    def retire(self, slot):
+        req = self._owner.pop(slot)
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+        return req
+
+    def occupancy(self):
+        return len(self._owner) / self.n_slots

@@ -175,8 +175,14 @@ class Executor:
                 }
             ext_vals = [t._data for t in program.externals]
             if train:
+                # the LR is re-read from the optimizer EVERY run and rides
+                # in as a traced operand — a scheduler stepped between runs
+                # changes the applied LR without recompiling
+                from paddle_tpu.static.graph import resolve_lr
+
+                lr_val = jnp.float32(resolve_lr(program.opt[0]))
                 fetches, new_ext, entry["slots"] = entry["fn"](
-                    feed_arrays, ext_vals, entry["slots"])
+                    feed_arrays, ext_vals, entry["slots"], lr_val)
                 # write updated params back into the shared Tensors (the
                 # Scope write the reference executor does)
                 for t, a in zip(program.externals, new_ext):

@@ -215,7 +215,10 @@ class Program:
         optimizer, loss_ref = opt
         train_mask = [not t.stop_gradient for t in self.externals]
 
-        def step_fn(feed_arrays, ext_vals, slots):
+        def step_fn(feed_arrays, ext_vals, slots, lr):
+            # lr is a TRACED f32 scalar re-read from the optimizer on every
+            # Executor.run — resolving a scheduler's get_lr() here (trace
+            # time) would freeze the schedule into the cached jitted step
             env0 = {("feed", n): a for n, a in zip(feed_names, feed_arrays)}
 
             def loss_of(train_vals):
@@ -229,7 +232,7 @@ class Program:
             (loss, env), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(train_vals)
             new_train, new_slots = _functional_step(
-                optimizer, train_vals, grads, slots)
+                optimizer, train_vals, grads, slots, lr)
             new_ext, it = [], iter(new_train)
             for a, m in zip(ext_vals, train_mask):
                 new_ext.append(next(it) if m else a)
@@ -252,20 +255,31 @@ def _hyper(opt, *names, default=None):
     return default
 
 
-def _functional_step(opt, params, grads, slots):
-    kind = type(opt).__name__
+def resolve_lr(opt):
+    """The optimizer's CURRENT scalar learning rate (an LRScheduler is
+    asked afresh). Called by Executor.run before every compiled step so
+    the schedule is threaded in as a traced operand, never frozen into
+    the cached program."""
     lr = _hyper(opt, "_learning_rate", "learning_rate", default=0.01)
     if callable(getattr(lr, "get_lr", None)):
         lr = lr.get_lr()
-    lr = float(lr) if not isinstance(lr, float) else lr
+    return float(lr)
+
+
+def _functional_step(opt, params, grads, slots, lr=None):
+    kind = type(opt).__name__
+    if lr is None:  # direct callers outside the compiled step
+        lr = resolve_lr(opt)
+    lr = jnp.asarray(lr, jnp.float32)
     if kind in ("SGD",):
-        return ([p - lr * g.astype(p.dtype) for p, g in
-                 zip(params, grads)], slots)
+        return ([p - (lr * g.astype(jnp.float32)).astype(p.dtype)
+                 for p, g in zip(params, grads)], slots)
     if kind in ("Momentum",):
         mu = _hyper(opt, "_momentum", "momentum", default=0.9)
         vel = slots.get("velocity") or [jnp.zeros_like(p) for p in params]
         new_v = [mu * v + g.astype(v.dtype) for v, g in zip(vel, grads)]
-        return ([p - lr * v for p, v in zip(params, new_v)],
+        return ([p - (lr * v.astype(jnp.float32)).astype(p.dtype)
+                 for p, v in zip(params, new_v)],
                 {**slots, "velocity": new_v})
     if kind in ("Adam", "AdamW"):
         b1 = _hyper(opt, "_beta1", "beta1", default=0.9)

@@ -20,6 +20,12 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: soak/arrival-trace tests excluded from the tier-1 run")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle

@@ -291,7 +291,8 @@ def test_inference_config_no_silent_knobs():
         ("enable_memory_optim", {}),
         ("enable_mkldnn", {}),
         ("enable_tensorrt_engine", {}),
-        ("enable_profile", {}),
+        # enable_profile is no longer inert: it wires Predictor.run wall
+        # time/call counts to serving.metrics (see tests/test_serving.py)
         ("set_cpu_math_library_num_threads", {"n": 4}),
     ]:
         with warnings.catch_warnings(record=True) as rec:

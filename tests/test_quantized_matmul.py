@@ -215,6 +215,30 @@ class TestDecodeAttention:
         np.testing.assert_allclose(np.asarray(out[:, 0]),
                                    np.asarray(flash[:, -1]), atol=1e-3)
 
+    def test_per_row_positions_match_reference(self):
+        """pos as an int32 [b] vector (continuous-batching decode: every
+        slot at its own depth, incl. a freshly-admitted row at 0) must
+        match the per-row masked reference — and agree with the scalar
+        kernel row-by-row when the vector is uniform."""
+        rng = np.random.default_rng(21)
+        b, nh, nkv, hd, cache_len = 3, 4, 2, 32, 256
+        q = jnp.asarray(rng.normal(size=(b, 1, nh, hd)), jnp.float32)
+        ck = jnp.asarray(rng.normal(size=(b, nkv, cache_len, hd)),
+                         jnp.float32)
+        cv = jnp.asarray(rng.normal(size=(b, nkv, cache_len, hd)),
+                         jnp.float32)
+        pos = jnp.asarray([7, 255, 0], jnp.int32)
+        with qm.fused_dispatch(enabled=True, interpret=_INTERPRET):
+            out = qm.decode_attention(q, ck, cv, pos)
+        ref = qm._decode_attention_xla(q, ck, cv, pos, 1.0 / np.sqrt(hd))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4)
+        with qm.fused_dispatch(enabled=True, interpret=_INTERPRET):
+            uni = qm.decode_attention(q, ck, cv,
+                                      jnp.full((b,), 100, jnp.int32))
+            sca = qm.decode_attention(q, ck, cv, jnp.int32(100))
+        np.testing.assert_array_equal(np.asarray(uni), np.asarray(sca))
+
     def test_supports_predicate(self):
         assert qm.decode_supported((1, 1, 8, 128), (1, 8, 256, 128))
         assert qm.decode_supported((1, 1, 8, 128), (1, 2, 256, 128))  # GQA

@@ -132,6 +132,40 @@ class TestTraining:
             lambda: paddle.optimizer.Adam(learning_rate=0.05))
         assert losses[-1] < losses[0] * 0.2
 
+    def test_lr_scheduler_not_frozen_into_compiled_step(self, static_mode):
+        """Advisor r5: the LR used to be resolved at TRACE time inside
+        _functional_step, freezing a scheduler's first value into the
+        cached jitted step. It now rides in as a traced operand re-read
+        each Executor.run: stepping the scheduler between runs must change
+        the APPLIED lr (visible in the parameter delta) with no recompile.
+        The loss here is linear in the fc weights, so the gradient is
+        feed-determined and identical across runs — delta ratios read the
+        applied LR directly."""
+        x = static.data("x", [4, 2], "float32")
+        y = static.nn.fc(x, 1)
+        loss = paddle.mean(y)
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1,
+                                              step_size=1, gamma=0.5)
+        paddle.optimizer.SGD(learning_rate=sched).minimize(loss)
+        exe = static.Executor()
+        exe.run(static.default_startup_program())
+        params = static.default_main_program().all_parameters()
+        xs = np.ones((4, 2), np.float32)
+
+        def snap():
+            return [np.asarray(p._data).copy() for p in params]
+
+        before = snap()
+        exe.run(feed={"x": xs}, fetch_list=[loss])
+        mid = snap()
+        sched.step()  # 0.1 -> 0.05
+        exe.run(feed={"x": xs}, fetch_list=[loss])
+        after = snap()
+        for b, m, a in zip(before, mid, after):
+            d1, d2 = m - b, a - m
+            assert np.abs(d1).max() > 0
+            np.testing.assert_allclose(d2, 0.5 * d1, rtol=1e-5, atol=1e-8)
+
     def test_param_updates_visible_in_eager(self, static_mode):
         lin = paddle.nn.Linear(2, 1)
         w_before = np.asarray(lin.weight._data).copy()

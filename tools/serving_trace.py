@@ -1,0 +1,85 @@
+"""Deterministic arrival-trace generator for the serving engine.
+
+One seeded generator shared by `bench.py --serving` and the slow soak
+test in `tests/test_serving.py`, so the benchmark and the test replay
+IDENTICAL traffic. Arrivals are Poisson-ish — exponential inter-arrival
+gaps — but measured in ENGINE STEPS, not wall-clock seconds: the trace
+is pure data, replayed by `serving.Engine.replay` which advances virtual
+time one scheduler iteration at a time, and no clock read ever enters
+traced code.
+
+    from tools.serving_trace import make_trace
+    trace = make_trace(seed=0, n_requests=24)
+    reqs = engine.replay(trace)
+
+CLI: `python tools/serving_trace.py --seed 0 --n 24` prints a JSON
+summary (lengths + arrival steps, not the token arrays).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["make_trace", "trace_stats"]
+
+
+def make_trace(seed=0, n_requests=24, mean_interarrival_steps=2.0,
+               prompt_len_choices=(3, 5, 7, 9, 12, 17, 23, 31),
+               new_tokens_choices=(4, 8, 12), vocab_size=128, pad_id=0,
+               eos_token_id=None):
+    """Mixed-length request trace: each entry is
+    {'request_id', 'arrival_step', 'prompt' (int32 [len], never pad_id),
+     'max_new_tokens'[, 'eos_token_id']} — the dict shape
+    `serving.Engine.replay` consumes. Deterministic for a given seed."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(mean_interarrival_steps, n_requests)
+    arrivals = np.floor(np.cumsum(gaps)).astype(int)
+    trace = []
+    for i in range(n_requests):
+        plen = int(rng.choice(prompt_len_choices))
+        prompt = rng.integers(1, vocab_size, size=plen).astype(np.int32)
+        if pad_id != 0:
+            prompt[prompt == pad_id] = (pad_id + 1) % vocab_size or 1
+        entry = {
+            "request_id": i,
+            "arrival_step": int(arrivals[i]),
+            "prompt": prompt,
+            "max_new_tokens": int(rng.choice(new_tokens_choices)),
+        }
+        if eos_token_id is not None:
+            entry["eos_token_id"] = int(eos_token_id)
+        trace.append(entry)
+    return trace
+
+
+def trace_stats(trace):
+    plens = [len(t["prompt"]) for t in trace]
+    return {
+        "n_requests": len(trace),
+        "total_new_tokens": sum(t["max_new_tokens"] for t in trace),
+        "prompt_len_min": min(plens),
+        "prompt_len_max": max(plens),
+        "distinct_prompt_lens": len(set(plens)),
+        "last_arrival_step": max(t["arrival_step"] for t in trace),
+    }
+
+
+if __name__ == "__main__":
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--n", type=int, default=24)
+    ap.add_argument("--mean-gap", type=float, default=2.0)
+    args = ap.parse_args()
+    trace = make_trace(seed=args.seed, n_requests=args.n,
+                       mean_interarrival_steps=args.mean_gap)
+    print(json.dumps({
+        "stats": trace_stats(trace),
+        "requests": [{"request_id": t["request_id"],
+                      "arrival_step": t["arrival_step"],
+                      "prompt_len": len(t["prompt"]),
+                      "max_new_tokens": t["max_new_tokens"]}
+                     for t in trace],
+    }, indent=2))
