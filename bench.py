@@ -198,8 +198,9 @@ def _run_single(spec_json):
                          loss_chunk=spec.get("loss_chunk"),
                          micro_batches=spec.get("micro_batches", 1),
                          moments=spec.get("moments", "f32"))
-    print("BENCH_RESULT " + json.dumps(
-        {"tps": tps, "flops_per_token": fpt, "params": n}))
+    record = {"tps": tps, "flops_per_token": fpt, "params": n}
+    print("BENCH_RESULT " + json.dumps(record))
+    return record
 
 
 def _bench_int8(steps=32, warmup=4):
@@ -257,6 +258,7 @@ def _bench_int8(steps=32, warmup=4):
             np.asarray(r[0]).ravel()[:1]
             out[mode] = batch * seq * steps / (time.perf_counter() - t0)
     print("BENCH_INT8 " + json.dumps(out))
+    return out
 
 
 def _bench_int8_decode(batches=(1, 4, 8), prompt=128, new_tokens=384,
@@ -324,6 +326,7 @@ def _bench_int8_decode(batches=(1, 4, 8), prompt=128, new_tokens=384,
         leg["int8"] = round(leg["int8"], 1)
         out["batches"][f"b{b}"] = leg
     print("BENCH_DECODE " + json.dumps(out))
+    return out
 
 
 def _bench_serving(seed=0):
@@ -420,14 +423,24 @@ def _bench_serving(seed=0):
         "speedup": round((srv_tokens / srv_dt) / (seq_tokens / seq_dt), 3),
         "ttft_s_mean": round(ttft["sum"] / ttft["count"], 4),
         "ttft_s_max": round(ttft["max"], 4),
+        # TTFT p50/p95/p99 (ROADMAP 2's acceptance metric) from the
+        # registry-backed histogram
+        "ttft_s_p50": round(ttft["p50"], 4),
+        "ttft_s_p95": round(ttft["p95"], 4),
+        "ttft_s_p99": round(ttft["p99"], 4),
         "slot_occupancy_mean": round(occ["sum"] / occ["count"], 3),
         "prefill_compiles": m["counters"]["prefill_compiles"],
         "decode_compiles": m["counters"]["decode_compiles"],
     }
     print("BENCH_SERVING " + json.dumps(out))
+    # the engine's metrics live in its PRIVATE registry (the global one
+    # never saw this run); stash it so a --telemetry-out sidecar can
+    # snapshot the real TTFT/occupancy histograms instead of an empty dict
+    _bench_serving.last_registry = eng.metrics.registry
+    return out
 
 
-def main():
+def main(telemetry_out=None):
     # the axon tunnel blocks indefinitely while another (possibly dead)
     # claimant wedges the claim; emit a diagnostic line instead of hanging
     # the driver forever
@@ -450,6 +463,29 @@ def main():
     signal.alarm(0)
     peak = _peak_for(kind) if backend == "tpu" else None
 
+    # every leg runs in a child process, so its monitors populate the
+    # CHILD's registry; forward --telemetry-out as a per-leg sidecar and
+    # merge the snapshots into the final artifact (metrics_by_leg)
+    leg_metrics = {}
+    tele_dir = None
+    if telemetry_out:
+        import tempfile
+
+        tele_dir = tempfile.mkdtemp(prefix="bench_telemetry_legs_")
+
+    def _tele_args(name):
+        return (["--telemetry-out", os.path.join(tele_dir, name + ".json")]
+                if tele_dir else [])
+
+    def _collect_leg(name):
+        if tele_dir is None:
+            return
+        try:
+            with open(os.path.join(tele_dir, name + ".json")) as f:
+                leg_metrics[name] = json.load(f)["metrics"]
+        except Exception:
+            pass  # the leg died before writing its sidecar
+
     results = []
     for cand in _candidate_configs(backend):
         cfg_kw, batch, seq = cand["cfg"], cand["batch"], cand["seq"]
@@ -466,10 +502,16 @@ def main():
                  + (f"_lc{cand['loss_chunk']}" if cand.get("loss_chunk")
                     else "")
                  + (f"_M{cand['micro_batches']}"
-                    if cand.get("micro_batches", 1) > 1 else ""))
+                    if cand.get("micro_batches", 1) > 1 else "")
+                 # moments variant must be in the label or the f32 and
+                 # factored legs collide (same configs[] label AND same
+                 # telemetry sidecar path)
+                 + (f"_mom-{cand['moments']}"
+                    if cand.get("moments", "f32") != "f32" else ""))
         try:
             out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--single", spec],
+                [sys.executable, os.path.abspath(__file__), "--single", spec]
+                + _tele_args(label),
                 capture_output=True, text=True, timeout=1800,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
             for line in out.stdout.splitlines():
@@ -479,6 +521,7 @@ def main():
                     r["cfg"] = cfg_kw
                     r["seq"], r["batch"] = seq, batch
                     results.append(r)
+                    _collect_leg(label)
                     break
             else:
                 print(f"bench {label} failed:\n{out.stderr[-2000:]}",
@@ -531,7 +574,8 @@ def main():
         # failure here must not cost the training headline
         try:
             out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--int8"],
+                [sys.executable, os.path.abspath(__file__), "--int8"]
+                + _tele_args("int8"),
                 capture_output=True, text=True, timeout=1200,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
             for line in out.stdout.splitlines():
@@ -542,6 +586,7 @@ def main():
                         "int8_tokens_per_sec": round(r["int8"], 1),
                         "speedup": round(r["int8"] / r["bf16"], 3),
                     }
+                    _collect_leg("int8")
                     break
             else:
                 print(f"int8 bench failed:\n{out.stderr[-2000:]}",
@@ -553,13 +598,15 @@ def main():
         # bf16 vs int8 params through the fused kernels, b in {1, 4, 8}
         try:
             out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--int8-decode"],
+                [sys.executable, os.path.abspath(__file__), "--int8-decode"]
+                + _tele_args("int8_decode"),
                 capture_output=True, text=True, timeout=1500,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
             for line in out.stdout.splitlines():
                 if line.startswith("BENCH_DECODE "):
                     record["int8_decode"] = json.loads(
                         line[len("BENCH_DECODE "):])
+                    _collect_leg("int8_decode")
                     break
             else:
                 print(f"int8 decode bench failed:\n{out.stderr[-2000:]}",
@@ -571,13 +618,15 @@ def main():
         # sequential generate on the deterministic mixed-length trace
         try:
             out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--serving"],
+                [sys.executable, os.path.abspath(__file__), "--serving"]
+                + _tele_args("serving"),
                 capture_output=True, text=True, timeout=1500,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
             for line in out.stdout.splitlines():
                 if line.startswith("BENCH_SERVING "):
                     record["serving"] = json.loads(
                         line[len("BENCH_SERVING "):])
+                    _collect_leg("serving")
                     break
             else:
                 print(f"serving bench failed:\n{out.stderr[-2000:]}",
@@ -585,18 +634,60 @@ def main():
         except subprocess.TimeoutExpired:
             print("serving bench timed out", file=sys.stderr)
 
+    if telemetry_out:
+        write_telemetry(telemetry_out, record, legs=leg_metrics)
+        if tele_dir is not None:
+            import shutil
+
+            shutil.rmtree(tele_dir, ignore_errors=True)
     print(json.dumps(record))
     return 0
 
 
+def write_telemetry(path, record, legs=None, registry=None):
+    """Structured per-run telemetry artifact: the bench record plus a full
+    registry snapshot (step-time histograms, compile counters, heartbeat
+    gauges from whatever ran in THIS process; main() additionally merges
+    each child leg's snapshot under metrics_by_leg) — perf regressions
+    become a JSON diff instead of a scrollback hunt."""
+    import jax
+
+    from paddle_tpu.observability import global_registry, write_run_telemetry
+    from paddle_tpu.observability.hardware import detect_device_kind
+
+    return write_run_telemetry(
+        path, record=record,
+        registry=registry if registry is not None else global_registry(),
+        legs=legs,
+        meta={"tool": "bench", "backend": jax.default_backend(),
+              "device_kind": detect_device_kind()})
+
+
+def _parse_argv(argv):
+    out = None
+    if "--telemetry-out" in argv:
+        i = argv.index("--telemetry-out")
+        if i + 1 >= len(argv):
+            print("--telemetry-out needs a PATH", file=sys.stderr)
+            raise SystemExit(2)
+        out = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
+    return argv, out
+
+
 if __name__ == "__main__":
-    if len(sys.argv) == 3 and sys.argv[1] == "--single":
-        _run_single(sys.argv[2])
-    elif len(sys.argv) == 2 and sys.argv[1] == "--int8":
-        _bench_int8()
-    elif len(sys.argv) == 2 and sys.argv[1] == "--int8-decode":
-        _bench_int8_decode()
-    elif len(sys.argv) == 2 and sys.argv[1] == "--serving":
-        _bench_serving()
+    _argv, _tele = _parse_argv(sys.argv[1:])
+    if len(_argv) == 2 and _argv[0] == "--single":
+        _rec = _run_single(_argv[1])
+    elif _argv == ["--int8"]:
+        _rec = _bench_int8()
+    elif _argv == ["--int8-decode"]:
+        _rec = _bench_int8_decode()
+    elif _argv == ["--serving"]:
+        _rec = _bench_serving()
     else:
-        sys.exit(main())
+        sys.exit(main(telemetry_out=_tele))
+    if _tele:  # subcommand modes write the same artifact shape as main()
+        write_telemetry(_tele, _rec,
+                        registry=getattr(_bench_serving, "last_registry",
+                                         None))

@@ -36,8 +36,10 @@ class RankFailure(RuntimeError):
 
 class CommMonitor:
     def __init__(self, store, rank, world_size, heartbeat_interval=1.0,
-                 miss_limit=5, on_failure=None, collective_timeout=300.0):
+                 miss_limit=5, on_failure=None, collective_timeout=300.0,
+                 registry=None):
         from paddle_tpu.core import native
+        from paddle_tpu.observability.registry import global_registry
 
         self.store = store
         self.rank = rank
@@ -46,6 +48,9 @@ class CommMonitor:
         self.miss_limit = miss_limit
         self.collective_timeout = collective_timeout
         self.failed_ranks = set()
+        # per-rank heartbeat-age gauges land in the shared telemetry
+        # registry, where TrainingMonitor.heartbeat_ages() reads them back
+        self.registry = registry if registry is not None else global_registry()
         self._on_failure = on_failure
         self._stop = threading.Event()
         self._timeouts = []
@@ -63,6 +68,7 @@ class CommMonitor:
                f"{ms} ms — peer ranks may be dead or desynchronized "
                f"(failed so far: {sorted(self.failed_ranks) or 'none'})")
         self._timeouts.append(name)
+        self.registry.inc("comm/watchdog_timeouts", labels={"op": name})
         print(msg, file=sys.stderr, flush=True)
 
     @contextlib.contextmanager
@@ -91,8 +97,20 @@ class CommMonitor:
                 self.store.set(f"hb/{self.rank}", repr(time.time()))
             except Exception:
                 pass  # the store itself died; peers will notice us missing
+            self.registry.set_gauge("comm/heartbeat_age_s", 0.0,
+                                    labels={"rank": self.rank})
             for r in range(self.world_size):
-                if r == self.rank or r in self.failed_ranks:
+                if r == self.rank:
+                    continue
+                if r in self.failed_ranks:
+                    # polling stops for dead ranks, but their age gauge
+                    # keeps advancing — a frozen (or absent) gauge would
+                    # read as a healthy rank instead of a dead one. Ranks
+                    # that never heartbeated age from monitor start.
+                    self.registry.set_gauge(
+                        "comm/heartbeat_age_s",
+                        time.monotonic() - last_change.get(r, started),
+                        labels={"rank": r})
                     continue
                 try:
                     val = self.store.get(f"hb/{r}", timeout=0.5)
@@ -104,17 +122,28 @@ class CommMonitor:
                     last_change[r] = now
                 if r in last_change:
                     stale = now - last_change[r]
+                    self.registry.set_gauge("comm/heartbeat_age_s", stale,
+                                            labels={"rank": r})
                     if stale > grace:
                         self._declare_dead(r, stale)
-                elif now - started > 10 * grace:
-                    # never heartbeated at all (died during startup)
-                    self._declare_dead(r, now - started)
+                else:
+                    # never heartbeated: still export an age (from monitor
+                    # start) so the rank is visible to heartbeat_ages()
+                    # during the startup grace window, not only after the
+                    # declare-dead below
+                    self.registry.set_gauge("comm/heartbeat_age_s",
+                                            now - started,
+                                            labels={"rank": r})
+                    if now - started > 10 * grace:
+                        # never heartbeated at all (died during startup)
+                        self._declare_dead(r, now - started)
             self._stop.wait(self.interval)
 
     def _declare_dead(self, r, stale):
         if r in self.failed_ranks:
             return
         self.failed_ranks.add(r)
+        self.registry.inc("comm/ranks_declared_dead")
         msg = (f"[comm-monitor] rank {self.rank}: rank {r} missed "
                f"heartbeats for {stale:.1f}s — declaring it DEAD")
         print(msg, file=sys.stderr, flush=True)

@@ -158,7 +158,7 @@ class HybridParallelEngine:
                  devices=None, dtype=jnp.float32, remat=True, lr=3e-4,
                  schedule="gpipe", num_virtual_stages=2, zero_stage=1,
                  loss_chunk=None, moments="f32", cp=1, cp_mode="ring",
-                 unroll=None):
+                 unroll=None, monitor=None):
         from paddle_tpu.models.llama import LlamaConfig  # noqa: F401 (type)
 
         self.config = config
@@ -275,6 +275,35 @@ class HybridParallelEngine:
         self._train_step = None
         self._opt_shardings = None
         self._param_shardings = None
+
+        # per-step telemetry into the shared registry. The default monitor
+        # uses nan_action='none': train_batch stays sync-free (no device->
+        # host loss readback in the step path — bench times through here),
+        # so step times are dispatch times; pass a TrainingMonitor with
+        # nan_action='raise'/'warn' for a loss-checked (synced) loop.
+        if monitor is None:
+            from paddle_tpu.observability import TrainingMonitor
+
+            monitor = TrainingMonitor(source="hybrid_engine",
+                                      nan_action="none")
+        self.monitor = monitor
+        if monitor.peak_flops == "auto":
+            # train_batch reports GLOBAL tokens/sec across the whole mesh,
+            # so the MFU denominator must be the whole mesh's peak — a
+            # single-chip peak would inflate MFU by the device count
+            from paddle_tpu.observability.hardware import detect_peak_flops
+
+            try:
+                per_chip = detect_peak_flops()
+            except Exception:
+                per_chip = None
+            monitor.peak_flops = (per_chip * self.mesh.devices.size
+                                  if per_chip else None)
+        # auto-fill MFU flops only when the monitor didn't come with a
+        # user-supplied flops_per_token (a custom model's FLOPs may not
+        # follow the llama formula)
+        self._fpt_auto = monitor.flops_per_token is None
+        self._fpt_seq = None  # seq len the monitor's flops_per_token is for
 
     # -- sharding specs -----------------------------------------------------
     def _build_param_specs(self):
@@ -1060,8 +1089,13 @@ class HybridParallelEngine:
                 check_vma=True)
 
         lr, moments = self.lr, self.moments
+        monitor = self.monitor
 
         def train_step(params, opt_state, ids, labels):
+            # trace-time side effect: runs exactly once per XLA compilation
+            # (a cached call never re-enters the traced Python), so this
+            # counter is precisely "train-step programs built"
+            monitor.record_compile("train_step")
             loss, grads = shard_mapped(params, ids, labels)
             new_params, new_opt = adamw_update(params, grads, opt_state,
                                                lr=lr, moments=moments)
@@ -1121,8 +1155,19 @@ class HybridParallelEngine:
         mon = _cm.get_comm_monitor()
         if mon is not None:
             mon.check_peers()  # fail fast if a rank died between steps
+        if self._fpt_auto and self._fpt_seq != ids.shape[-1]:
+            from paddle_tpu.observability.hardware import llama_flops_per_token
+
+            # attention FLOPs/token scale with seq, so refresh on change
+            # (mixed-length training would otherwise skew MFU)
+            self.monitor.flops_per_token = llama_flops_per_token(
+                self.args, ids.shape[-1])
+            self._fpt_seq = ids.shape[-1]
+        self.monitor.start_step()
         with _cm.guard("compiled_train_step"):
             out = step(params, opt_state, ids, labels)
+        # ids is [M, mb, s] global, so .size is the whole-batch token count
+        self.monitor.end_step(loss=out[0], tokens=ids.size)
         from paddle_tpu.amp import debugging as _dbg
 
         if _dbg.checking_enabled():  # FLAGS_check_nan_inf post-step scan

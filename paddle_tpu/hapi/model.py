@@ -96,8 +96,15 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, nan_action="warn"):
         from paddle_tpu.io import DataLoader, Dataset
+        from paddle_tpu.observability import TrainingMonitor
+
+        # per-step telemetry (wall time, samples/sec, HBM high-water, the
+        # NaN/inf loss action) into the shared registry; train_batch already
+        # reads the loss back to host each step, so the check adds no sync
+        self._monitor = TrainingMonitor(source="hapi_fit",
+                                        nan_action=nan_action)
 
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
@@ -191,8 +198,12 @@ class Model:
             data = data if isinstance(data, (list, tuple)) else [data]
             n_in = len(self._inputs) if self._inputs else 1
             ins, lbls = data[:n_in], data[n_in:]
+            monitor = getattr(self, "_monitor", None) if mode == "train" \
+                else None
             if mode == "train":
+                t0 = time.perf_counter() if monitor else None
                 losses, metrics = self.train_batch(ins, lbls)
+                step_wall = (time.perf_counter() - t0) if monitor else None
             elif mode == "eval":
                 losses, metrics = self.eval_batch(ins, lbls)
             else:
@@ -201,6 +212,9 @@ class Model:
             batch0 = ins[0]
             bsz = batch0.shape[0] if hasattr(batch0, "shape") else 1
             batch_loss = float(np.asarray(losses[0]).reshape(-1)[0])
+            if monitor is not None:
+                monitor.record_step(step_wall, loss_value=batch_loss,
+                                    samples=bsz)
             loss_sum += batch_loss * bsz
             seen += bsz
             logs["loss"] = loss_sum / max(seen, 1)

@@ -1,0 +1,32 @@
+"""paddle_tpu.observability: the framework-wide telemetry layer.
+
+Three pieces, one data flow (see ARCHITECTURE.md "Observability"):
+
+  registry.py — `MetricsRegistry`: thread-safe labeled counters / gauges /
+      fixed-bucket histograms (p50/p95/p99), JSON + Prometheus exporters.
+      `global_registry()` is the shared default every subsystem reports to;
+      `serving/metrics.py` is a back-compat facade over it.
+  monitor.py  — `TrainingMonitor`: per-step wall time, tokens/sec, MFU,
+      HBM high-water, trace-time compile counters, NaN/inf loss action;
+      hooked into the hybrid engine, the static Executor, and hapi fit.
+      Heartbeat-age gauges arrive from `distributed/comm_monitor.py`.
+  telemetry.py — `write_run_telemetry`: the structured JSON artifact bench
+      and the dryrun emit per run.
+
+Offline device-time attribution lives in `tools/xprof_report.py`, built on
+`profiler._parse_device_trace`.
+"""
+
+from paddle_tpu.observability.registry import (  # noqa: F401
+    DEFAULT_BUCKETS, MetricsRegistry, global_registry, set_global_registry)
+from paddle_tpu.observability.monitor import (  # noqa: F401
+    NonFiniteLossError, TrainingMonitor)
+from paddle_tpu.observability.telemetry import (  # noqa: F401
+    SCHEMA, write_run_telemetry)
+from paddle_tpu.observability import hardware  # noqa: F401
+
+__all__ = [
+    "DEFAULT_BUCKETS", "MetricsRegistry", "global_registry",
+    "set_global_registry", "NonFiniteLossError", "TrainingMonitor",
+    "SCHEMA", "write_run_telemetry", "hardware",
+]

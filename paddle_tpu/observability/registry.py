@@ -1,0 +1,303 @@
+"""Framework-wide metrics registry: the one place every subsystem reports to.
+
+The reference instruments each layer separately (host tracer brackets in
+every generated API, `comm_task_manager.cc` watchdog counters, PaddleNLP's
+serving metrics); here ONE dependency-free, thread-safe registry backs all
+of them:
+
+  - counters  — monotonic, labeled (`inc` / `counter`);
+  - gauges    — last value + running max, labeled (`set_gauge` / `gauge`);
+  - histograms — fixed-bucket observations with p50/p95/p99 quantile
+    estimation (`observe` / `observation` / `quantile`). Quantiles use the
+    Prometheus `histogram_quantile` rule: linear interpolation inside the
+    bucket that crosses the rank, clamped to the observed [min, max] so a
+    sparse histogram never reports a value outside what was seen.
+
+Exports: `snapshot()` (JSON-able nested dict) and `to_prometheus()`
+(Prometheus text exposition format), both deterministic (sorted names and
+label sets) so they golden-test cleanly.
+
+Every mutator and reader takes the registry lock; callbacks on streaming
+threads, the comm-monitor heartbeat thread, and trace-time compile-counter
+bumps can all hit one registry concurrently. Nothing here runs inside
+traced code except counter bumps a caller deliberately places at trace
+time (the serving compile-count pattern).
+"""
+
+from __future__ import annotations
+
+import bisect
+import contextlib
+import math
+import re
+import threading
+import time
+
+__all__ = ["MetricsRegistry", "global_registry", "set_global_registry",
+           "DEFAULT_BUCKETS"]
+
+# 1-2.5-5 ladder per decade, 1us .. 5e9: wide enough that the same default
+# serves second-scale timers, tokens/sec rates, and byte counts. Bounds are
+# parsed from literals (not m * 10**e) so exporters print clean values.
+DEFAULT_BUCKETS = tuple(float(f"{m}e{e}") for e in range(-6, 10)
+                        for m in ("1", "2.5", "5"))
+
+
+def _label_key(labels):
+    if not labels:
+        return ()
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _label_str(lkey):
+    return ",".join(f"{k}={v}" for k, v in lkey)
+
+
+class _Histogram:
+    __slots__ = ("buckets", "counts", "count", "sum", "min", "max")
+
+    def __init__(self, buckets):
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = b
+        self.counts = [0] * (len(b) + 1)  # [-1] = overflow (+Inf)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def add(self, value):
+        v = float(value)
+        self.count += 1
+        self.sum += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+        self.counts[bisect.bisect_left(self.buckets, v)] += 1
+
+    def quantile(self, q):
+        """Prometheus-style: interpolate inside the bucket whose cumulative
+        count crosses rank q*count; the first bucket's lower edge is the
+        observed min and the overflow bucket's upper edge is the observed
+        max, with a final clamp to [min, max]."""
+        if not self.count:
+            return None
+        rank = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if c > 0 and cum + c >= rank:
+                lo = self.buckets[i - 1] if i > 0 else self.min
+                hi = self.buckets[i] if i < len(self.buckets) else self.max
+                v = lo + (hi - lo) * ((rank - cum) / c)
+                return min(max(v, self.min), self.max)
+            cum += c
+        return self.max
+
+    def stats(self):
+        return {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.count else None,
+            "max": self.max if self.count else None,
+            "mean": self.sum / self.count if self.count else None,
+            "p50": self.quantile(0.50),
+            "p95": self.quantile(0.95),
+            "p99": self.quantile(0.99),
+        }
+
+
+class MetricsRegistry:
+    """Thread-safe counters / gauges / fixed-bucket histograms with labels."""
+
+    def __init__(self, default_buckets=DEFAULT_BUCKETS):
+        self._lock = threading.RLock()
+        self._default_buckets = tuple(default_buckets)
+        self._counters = {}      # name -> {lkey: value}
+        self._gauges = {}        # name -> {lkey: {"value", "max"}}
+        self._hists = {}         # name -> {lkey: _Histogram}
+        self._hist_buckets = {}  # name -> declared bounds
+
+    # -- counters -----------------------------------------------------------
+    def inc(self, name, value=1, labels=None):
+        k = _label_key(labels)
+        with self._lock:
+            series = self._counters.setdefault(name, {})
+            series[k] = series.get(k, 0) + value
+
+    def counter(self, name, labels=None):
+        with self._lock:
+            return self._counters.get(name, {}).get(_label_key(labels), 0)
+
+    # -- gauges -------------------------------------------------------------
+    def set_gauge(self, name, value, labels=None):
+        k = _label_key(labels)
+        with self._lock:
+            series = self._gauges.setdefault(name, {})
+            g = series.get(k)
+            if g is None:
+                series[k] = {"value": value, "max": value}
+            else:
+                g["value"] = value
+                g["max"] = max(g["max"], value)
+
+    def gauge(self, name, labels=None):
+        with self._lock:
+            g = self._gauges.get(name, {}).get(_label_key(labels))
+            return g["value"] if g else 0
+
+    def gauge_series(self, name):
+        """{label_str: value} for one gauge metric — a cheap point read
+        for pollers (snapshot() would compute quantiles for every
+        histogram in the registry just to read a few gauges)."""
+        with self._lock:
+            return {_label_str(k): g["value"]
+                    for k, g in self._gauges.get(name, {}).items()}
+
+    # -- histograms ---------------------------------------------------------
+    def declare_histogram(self, name, buckets):
+        """Pin this metric's bucket bounds (applies to series created
+        later; already-created series keep their bounds)."""
+        with self._lock:
+            self._hist_buckets[name] = tuple(sorted(float(b)
+                                                    for b in buckets))
+
+    def observe(self, name, value, labels=None, buckets=None):
+        k = _label_key(labels)
+        with self._lock:
+            series = self._hists.setdefault(name, {})
+            h = series.get(k)
+            if h is None:
+                h = series[k] = _Histogram(
+                    buckets or self._hist_buckets.get(
+                        name, self._default_buckets))
+            h.add(value)
+
+    def observation(self, name, labels=None):
+        """count/sum/min/max/mean + p50/p95/p99, or None if never observed
+        (the serving Metrics contract)."""
+        with self._lock:
+            h = self._hists.get(name, {}).get(_label_key(labels))
+            return h.stats() if h else None
+
+    def quantile(self, name, q, labels=None):
+        with self._lock:
+            h = self._hists.get(name, {}).get(_label_key(labels))
+            return h.quantile(q) if h else None
+
+    @contextlib.contextmanager
+    def timer(self, name, labels=None):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0, labels=labels)
+
+    # -- export -------------------------------------------------------------
+    def snapshot(self):
+        """JSON-able nested dict: {kind: {name: {label_str: stats}}}.
+        Deterministic ordering (sorted names / labels)."""
+        with self._lock:
+            out = {"counters": {}, "gauges": {}, "histograms": {}}
+            for name in sorted(self._counters):
+                out["counters"][name] = {
+                    _label_str(k): v
+                    for k, v in sorted(self._counters[name].items())}
+            for name in sorted(self._gauges):
+                out["gauges"][name] = {
+                    _label_str(k): dict(g)
+                    for k, g in sorted(self._gauges[name].items())}
+            for name in sorted(self._hists):
+                out["histograms"][name] = {
+                    _label_str(k): h.stats()
+                    for k, h in sorted(self._hists[name].items())}
+            return out
+
+    def to_prometheus(self):
+        """Prometheus text exposition format (counters, then gauges, then
+        histograms with cumulative `_bucket{le=...}` series)."""
+        with self._lock:
+            lines = []
+            for name in sorted(self._counters):
+                san = _san(name)
+                lines.append(f"# TYPE {san} counter")
+                for k, v in sorted(self._counters[name].items()):
+                    lines.append(f"{san}{_prom_labels(k)} {_fmt_num(v)}")
+            for name in sorted(self._gauges):
+                san = _san(name)
+                lines.append(f"# TYPE {san} gauge")
+                for k, g in sorted(self._gauges[name].items()):
+                    lines.append(
+                        f"{san}{_prom_labels(k)} {_fmt_num(g['value'])}")
+            for name in sorted(self._hists):
+                san = _san(name)
+                lines.append(f"# TYPE {san} histogram")
+                for k, h in sorted(self._hists[name].items()):
+                    cum = 0
+                    for ub, c in zip(h.buckets, h.counts):
+                        cum += c
+                        le = _prom_labels(k, extra=("le", _fmt_num(ub)))
+                        lines.append(f"{san}_bucket{le} {cum}")
+                    le = _prom_labels(k, extra=("le", "+Inf"))
+                    lines.append(f"{san}_bucket{le} {h.count}")
+                    lines.append(f"{san}_sum{_prom_labels(k)} "
+                                 f"{_fmt_num(h.sum)}")
+                    lines.append(f"{san}_count{_prom_labels(k)} {h.count}")
+            return "\n".join(lines) + ("\n" if lines else "")
+
+    def reset(self, keep_counters=()):
+        """Clear everything except counters with the named metric names
+        (the serving engine keeps its trace-time compile counters across a
+        warmup reset)."""
+        with self._lock:
+            self._counters = {k: v for k, v in self._counters.items()
+                              if k in keep_counters}
+            self._gauges = {}
+            self._hists = {}
+
+
+def _san(name):
+    s = re.sub(r"[^a-zA-Z0-9_:]", "_", str(name))
+    return "_" + s if s[:1].isdigit() else s
+
+
+def _fmt_num(v):
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _prom_labels(lkey, extra=None):
+    items = list(lkey)
+    if extra is not None:
+        items.append(extra)
+    if not items:
+        return ""
+    def esc(s):
+        return str(s).replace("\\", "\\\\").replace('"', '\\"') \
+                     .replace("\n", "\\n")
+    return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in items) + "}"
+
+
+# -- the shared default registry --------------------------------------------
+
+_global = None
+_global_lock = threading.Lock()
+
+
+def global_registry():
+    """The process-wide registry: the hybrid engine, static Executor, hapi
+    fit, and comm-monitor heartbeats all report here by default."""
+    global _global
+    if _global is None:
+        with _global_lock:
+            if _global is None:
+                _global = MetricsRegistry()
+    return _global
+
+
+def set_global_registry(registry):
+    global _global
+    with _global_lock:
+        prev, _global = _global, registry
+    return prev

@@ -21,7 +21,7 @@ from enum import Enum
 __all__ = [
     "Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
     "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-    "SortedKeys", "SummaryView",
+    "LoadedProfilerResult", "SortedKeys", "SummaryView",
 ]
 
 
@@ -98,24 +98,67 @@ class RecordEvent:
 def export_chrome_tracing(dir_name, worker_name=None):
     """on_trace_ready handler writing a merged chrome trace (reference
     `platform/profiler/chrometracing_logger.cc`): host op dispatches +
-    the xprof device lanes in one chrome://tracing-loadable file."""
+    the xprof device lanes in one chrome://tracing-loadable file. Repeated
+    sessions get a run-index suffix (worker.json, worker_1.json, ...)
+    instead of silently overwriting the previous trace."""
     def handler(prof):
         import os
 
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or "worker"
-        prof.export_chrome_trace(os.path.join(dir_name, f"{name}.json"))
+        path = os.path.join(dir_name, f"{name}.json")
+        idx = 1
+        while os.path.exists(path):
+            path = os.path.join(dir_name, f"{name}_{idx}.json")
+            idx += 1
+        prof.export_chrome_trace(path)
 
     return handler
 
 
+def _parse_trace_data(data, per_op=None, raw=None):
+    """Extract device-lane events from ONE chrome-trace dict (xprof's
+    *.trace.json payload): TPU lanes are processes named `/device:TPU:N`
+    with `XLA Ops` / `XLA Modules` threads (per-HLO / per-module events).
+    Merges into the given per_op/raw accumulators and returns
+    (per_op, module_busy_seconds, raw_events). Raw events carry the pid so
+    downstream consumers (tools/xprof_report.py) can group per device."""
+    per_op = defaultdict(list) if per_op is None else per_op
+    raw = [] if raw is None else raw
+    module_busy = 0.0
+    evs = data.get("traceEvents", [])
+    procs, threads = {}, {}
+    for e in evs:
+        if e.get("ph") == "M":
+            nm = e.get("args", {}).get("name", "")
+            if e.get("name") == "process_name":
+                procs[e.get("pid")] = nm
+            elif e.get("name") == "thread_name":
+                threads[(e.get("pid"), e.get("tid"))] = nm
+    for e in evs:
+        if e.get("ph") != "X":
+            continue
+        pn = procs.get(e.get("pid"), "")
+        tn = threads.get((e.get("pid"), e.get("tid")), "")
+        if not ("/device:" in pn or pn.startswith("TPU")
+                or "XLA Ops" in tn or "XLA Modules" in tn):
+            continue
+        dur_s = float(e.get("dur", 0.0)) / 1e6
+        raw.append({"name": e.get("name", "?"), "ts": e.get("ts", 0),
+                    "dur": e.get("dur", 0.0), "lane": tn or pn,
+                    "pid": e.get("pid", 0)})
+        if "Modules" in tn:
+            module_busy += dur_s  # whole-module span: busy, not per-op
+        else:
+            per_op[e.get("name", "?")].append(dur_s)
+    return per_op, module_busy, raw
+
+
 def _parse_device_trace(log_dir):
-    """Per-op DEVICE time from the xprof dump (VERDICT r4 item 8): the
-    latest `plugins/profile/<run>/` holds `*.trace.json.gz` whose TPU
-    lanes are processes named `/device:TPU:N` with `XLA Ops` /
-    `XLA Modules` threads (per-HLO / per-module events). Returns
-    ({event_name: [dur_seconds]}, device_busy_seconds, raw_events) —
-    empty on host-only traces (XLA:CPU compute runs in host threads)."""
+    """Per-op DEVICE time from the xprof dump (VERDICT r4 item 8): reads
+    the latest `plugins/profile/<run>/*.trace.json.gz` under `log_dir`.
+    Returns ({event_name: [dur_seconds]}, device_busy_seconds, raw_events)
+    — empty on host-only traces (XLA:CPU compute runs in host threads)."""
     import glob
     import gzip
     import json
@@ -133,36 +176,85 @@ def _parse_device_trace(log_dir):
             data = json.loads(gzip.open(tj).read())
         except Exception:
             continue
-        evs = data.get("traceEvents", [])
-        procs, threads = {}, {}
-        for e in evs:
-            if e.get("ph") == "M":
-                nm = e.get("args", {}).get("name", "")
-                if e.get("name") == "process_name":
-                    procs[e.get("pid")] = nm
-                elif e.get("name") == "thread_name":
-                    threads[(e.get("pid"), e.get("tid"))] = nm
-        for e in evs:
-            if e.get("ph") != "X":
-                continue
-            pn = procs.get(e.get("pid"), "")
-            tn = threads.get((e.get("pid"), e.get("tid")), "")
-            if not ("/device:" in pn or pn.startswith("TPU")
-                    or "XLA Ops" in tn or "XLA Modules" in tn):
-                continue
-            dur_s = float(e.get("dur", 0.0)) / 1e6
-            raw.append({"name": e.get("name", "?"), "ts": e.get("ts", 0),
-                        "dur": e.get("dur", 0.0), "lane": tn or pn})
-            if "Modules" in tn:
-                module_busy += dur_s  # whole-module span: busy, not per-op
-            else:
-                per_op[e.get("name", "?")].append(dur_s)
+        per_op, mb, raw = _parse_trace_data(data, per_op, raw)
+        module_busy += mb
     busy = module_busy or sum(sum(v) for v in per_op.values())
     return dict(per_op), busy, raw
 
 
+class LoadedProfilerResult:
+    """Offline view over a saved trace: the same StatisticData the live
+    Profiler builds, so `summary()` renders the full table set without the
+    original process."""
+
+    def __init__(self, statistic_data):
+        self.statistic_data = statistic_data
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None, row_limit=100):
+        table = build_table(self.statistic_data,
+                            sorted_by=sorted_by or SortedKeys.CPUTotal,
+                            views=views, time_unit=time_unit,
+                            row_limit=row_limit, op_detail=op_detail)
+        print(table)
+        return table
+
+
 def load_profiler_result(path):
-    raise NotImplementedError("open the xprof dump with tensorboard/xprof")
+    """Load a saved profiling run back into a summarizable result
+    (reference `profiler/profiler.py` load_profiler_result):
+
+      - a chrome trace written by `Profiler.export_chrome_trace` /
+        `export_chrome_tracing` (host `cat:"op"` lane + device
+        `cat:"device"` lanes) -> host op stats + device attribution;
+      - an xprof log dir -> device lanes only (via _parse_device_trace).
+
+    Returns a `LoadedProfilerResult`; `.summary()` works offline."""
+    import json
+    import os
+
+    from collections import defaultdict as _dd
+
+    if os.path.isdir(path):
+        dev_events, dev_total, _ = _parse_device_trace(path)
+        data = StatisticData({}, {}, [], device_events=dev_events,
+                             device_total=dev_total)
+        return LoadedProfilerResult(data)
+
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        trace = json.loads(f.read())
+    op_events, dev_events = _dd(list), _dd(list)
+    module_busy = 0.0
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "X":
+            continue
+        dur_s = float(e.get("dur", 0.0)) / 1e6
+        cat = e.get("cat")
+        if cat == "op":
+            op_events[e.get("name", "?")].append(dur_s)
+        elif cat == "device":
+            lane = (e.get("args") or {}).get("lane", "")
+            if "Modules" in lane:
+                module_busy += dur_s
+            else:
+                dev_events[e.get("name", "?")].append(dur_s)
+    if not op_events and not dev_events and not module_busy:
+        # not one of our chrome exports (no cat:"op"/"device" events) —
+        # treat it as a raw xprof dump, whose device lanes are identified
+        # via process_name/thread_name metadata (same parser the xprof
+        # report CLI uses)
+        dev, total, _ = _parse_trace_data(trace)
+        return LoadedProfilerResult(StatisticData({}, {}, [],
+                                                  device_events=dev,
+                                                  device_total=total))
+    dev_total = module_busy or sum(sum(v) for v in dev_events.values())
+    data = StatisticData(dict(op_events), {}, [],
+                         device_events=dict(dev_events),
+                         device_total=dev_total)
+    return LoadedProfilerResult(data)
 
 
 class Profiler:
@@ -171,12 +263,18 @@ class Profiler:
 
     def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
-                 with_flops=False, log_dir="./profiler_log"):
+                 with_flops=False, log_dir=None):
+        import os
+
         self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.CUSTOM_DEVICE]
         self.scheduler = scheduler if callable(scheduler) else None
         self.on_trace_ready = on_trace_ready
         self.timer_only = timer_only
-        self.log_dir = log_dir
+        # default dump dir routes through PADDLE_PROFILER_LOG_DIR so test
+        # rigs / batch jobs can redirect every profiler without touching
+        # call sites (the tests' tmp_path fixture sets it)
+        self.log_dir = log_dir or os.environ.get("PADDLE_PROFILER_LOG_DIR",
+                                                 "./profiler_log")
         self.current_state = ProfilerState.CLOSED
         self._step = 0
         self._tracing = False
@@ -242,8 +340,13 @@ class Profiler:
     def step_info(self, unit=None):
         if not self._step_times:
             return ""
+        unit = unit or "ms"
+        scale = {"s": 1.0, "ms": 1e3, "us": 1e6}.get(unit)
+        if scale is None:
+            raise ValueError(f"unit must be 's', 'ms' or 'us', got {unit!r}")
         avg = sum(self._step_times) / len(self._step_times)
-        return f"avg step time {avg * 1e3:.2f} ms over {len(self._step_times)} steps"
+        return (f"avg step time {avg * scale:.2f} {unit} over "
+                f"{len(self._step_times)} steps")
 
     def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
                 thread_sep=False, time_unit="ms", views=None, row_limit=100):

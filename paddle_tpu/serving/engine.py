@@ -84,9 +84,11 @@ class Request:
         self.finished = False
         self.finish_reason = None
         self.submit_time = None
+        self.submit_step = None
         self.first_token_time = None
         self.finish_time = None
         self.ttft_s = None
+        self.ttft_steps = None
 
     def output_ids(self):
         """prompt + generated tokens (the sequential-generate row shape,
@@ -183,6 +185,7 @@ class Engine:
                 f"request needs {n} prompt + {req.max_new_tokens} new "
                 f"tokens but the slot capacity is max_len={self.max_len}")
         req.submit_time = time.perf_counter()
+        req.submit_step = self.step_count
         self.queue.push(req)
         self.metrics.inc("requests_submitted")
         return req
@@ -263,8 +266,13 @@ class Engine:
             first = int(first)
         now = time.perf_counter()
         req.first_token_time = now
+        # TTFT in wall-clock seconds AND in engine steps: steps are the
+        # load-independent scheduling-delay unit arrival traces are written
+        # in; seconds are what ROADMAP 2's p99 acceptance is measured in
         req.ttft_s = now - req.submit_time
+        req.ttft_steps = self.step_count - req.submit_step
         self.metrics.observe("ttft_s", req.ttft_s)
+        self.metrics.observe("ttft_steps", req.ttft_steps)
         self.metrics.inc("prefills")
         self.metrics.inc("tokens_generated")
         self._npos[slot] = n

@@ -1,97 +1,89 @@
-"""Serving metrics: counters / gauges / observations for the engine and
-the inference Predictor.
+"""Serving metrics: back-compat facade over the framework-wide registry.
 
-The reference ships a GPU-serving metrics layer in PaddleNLP's serving
-stack (queue depth, first-token latency, QPS); here one small dependency-
-free registry backs three consumers:
+Historically this module owned a small dict-based registry for the serving
+engine; PR 6 promoted it to `paddle_tpu.observability.MetricsRegistry`
+(thread-safe, labeled series, fixed-bucket histograms with p50/p95/p99,
+JSON + Prometheus exporters) and this `Metrics` class became a thin shim
+keeping the original call surface:
 
   - `serving.Engine` — queue depth, slot occupancy, per-step tokens/sec,
-    time-to-first-token, and COMPILE COUNTS (incremented at trace time:
-    the jitted step bodies bump a counter as a Python side effect, which
-    runs exactly once per XLA compilation — a cached call never re-enters
-    the traced Python, so the counter is precisely "programs built");
+    time-to-first-token (wall seconds AND engine steps), and COMPILE
+    COUNTS (incremented at trace time: the jitted step bodies bump a
+    counter as a Python side effect, which runs exactly once per XLA
+    compilation — a cached call never re-enters the traced Python, so the
+    counter is precisely "programs built");
   - `inference.Config.enable_profile()` — Predictor.run wall time + call
     counts, retrievable via `Predictor.summary()`;
-  - `bench.py --serving` — the throughput/TTFT artifact.
+  - `bench.py --serving` — the throughput/TTFT artifact, now with TTFT
+    p50/p95/p99 (ROADMAP 2's acceptance metric).
 
+Mutators are thread-safe: streaming callbacks and the comm-monitor
+heartbeat thread can race `inc`/`observe` against the scheduler loop.
 Nothing here runs inside traced code except the trace-time counter bumps;
 no wall-clock reads ever enter a jitted program.
 """
 
 from __future__ import annotations
 
-import contextlib
-import time
+from paddle_tpu.observability.registry import MetricsRegistry
 
 __all__ = ["Metrics"]
 
 
 class Metrics:
     """Counters (monotonic), gauges (last value + max), observations
-    (count/sum/min/max streaming summaries)."""
+    (count/sum/min/max/mean + p50/p95/p99 quantile summaries)."""
 
-    def __init__(self):
-        self._counters = {}
-        self._gauges = {}
-        self._obs = {}
+    def __init__(self, registry=None):
+        # each Metrics() gets its OWN registry: reset() clears the registry
+        # wholesale and summary() reads unlabeled series, so this registry
+        # must stay engine-private. Do NOT pass the process-global registry
+        # here — Engine.reset() would wipe every other subsystem's
+        # telemetry; publish serving numbers via the bench record /
+        # telemetry artifacts instead.
+        self.registry = registry if registry is not None else MetricsRegistry()
 
     # -- counters -----------------------------------------------------------
     def inc(self, name, value=1):
-        self._counters[name] = self._counters.get(name, 0) + value
+        self.registry.inc(name, value)
 
     def counter(self, name):
-        return self._counters.get(name, 0)
+        return self.registry.counter(name)
 
     # -- gauges -------------------------------------------------------------
     def set_gauge(self, name, value):
-        g = self._gauges.setdefault(name, {"value": 0, "max": value})
-        g["value"] = value
-        g["max"] = max(g["max"], value)
+        self.registry.set_gauge(name, value)
 
     def gauge(self, name):
-        g = self._gauges.get(name)
-        return g["value"] if g else 0
+        return self.registry.gauge(name)
 
     # -- observations -------------------------------------------------------
     def observe(self, name, value):
-        value = float(value)
-        o = self._obs.get(name)
-        if o is None:
-            self._obs[name] = {"count": 1, "sum": value, "min": value,
-                               "max": value}
-        else:
-            o["count"] += 1
-            o["sum"] += value
-            o["min"] = min(o["min"], value)
-            o["max"] = max(o["max"], value)
+        self.registry.observe(name, float(value))
 
     def observation(self, name):
-        o = self._obs.get(name)
-        if not o:
-            return None
-        return dict(o, mean=o["sum"] / o["count"])
+        return self.registry.observation(name)
 
-    @contextlib.contextmanager
     def timer(self, name):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.observe(name, time.perf_counter() - t0)
+        return self.registry.timer(name)
 
     # -- reporting ----------------------------------------------------------
     def summary(self):
+        snap = self.registry.snapshot()  # one atomic read
         return {
-            "counters": dict(self._counters),
-            "gauges": {k: dict(v) for k, v in self._gauges.items()},
-            "observations": {k: self.observation(k) for k in self._obs},
+            "counters": {k: v.get("", 0)
+                         for k, v in snap["counters"].items()},
+            "gauges": {k: dict(v.get("", {"value": 0, "max": 0}))
+                       for k, v in snap["gauges"].items()},
+            "observations": {k: v.get("")
+                             for k, v in snap["histograms"].items()},
         }
+
+    def to_prometheus(self):
+        return self.registry.to_prometheus()
 
     def reset(self, keep_counters=()):
         """Clear everything except the named counters — the engine's
         compile counters survive a reset so warmup + timed benchmark runs
         on one engine still report honest compile totals."""
-        kept = {k: v for k, v in self._counters.items() if k in keep_counters}
-        self._counters = kept
-        self._gauges = {}
-        self._obs = {}
+        self.registry.reset(keep_counters=keep_counters)

@@ -134,6 +134,17 @@ class Executor:
     def __init__(self, place=None):
         self.place = place
         self._predictor = None
+        self._monitor = None
+
+    def _get_monitor(self):
+        if self._monitor is None:
+            from paddle_tpu.observability import TrainingMonitor
+
+            # nan_action='none': fetches are returned to the caller anyway
+            # (Executor.run is synchronous), so no extra readback is added
+            self._monitor = TrainingMonitor(source="static_executor",
+                                            nan_action="none")
+        return self._monitor
 
     def run(self, program=None, feed=None, fetch_list=None, **kw):
         import jax.numpy as jnp
@@ -167,13 +178,20 @@ class Executor:
                    tuple(feed_names),
                    tuple((a.shape, str(a.dtype)) for a in feed_arrays),
                    tuple(fetch_refs))
+            monitor = self._get_monitor()
             entry = program._run_cache.get(key)
             if entry is None:
+                # a cache miss IS a compilation on this executor (one jitted
+                # program per feed-shape signature)
+                monitor.record_compile("train" if train else "infer")
                 entry = program._run_cache[key] = {
                     "fn": program.compile(feed_names, fetch_refs, train),
                     "slots": {},
                 }
             ext_vals = [t._data for t in program.externals]
+            samples = feed_arrays[0].shape[0] if (
+                feed_arrays and feed_arrays[0].ndim) else None
+            monitor.start_step()
             if train:
                 # the LR is re-read from the optimizer EVERY run and rides
                 # in as a traced operand — a scheduler stepped between runs
@@ -189,7 +207,10 @@ class Executor:
                     t._data = a
             else:
                 fetches = entry["fn"](feed_arrays, ext_vals)
-            return [np.asarray(f) for f in fetches]
+            out = [np.asarray(f) for f in fetches]
+            # the asarray readback above synced, so this is true step time
+            monitor.end_step(samples=samples)
+            return out
         if callable(program):
             out = program(**(feed or {}))
             return out if isinstance(out, (list, tuple)) else [out]

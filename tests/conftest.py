@@ -33,3 +33,13 @@ def _seed():
     paddle.seed(1234)
     np.random.seed(1234)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _profiler_dumps_to_tmp(tmp_path, monkeypatch):
+    """Route every profiler/xprof dump through tmp_path: Profiler's default
+    log_dir resolves PADDLE_PROFILER_LOG_DIR, so no test run litters
+    ./profiler_log into the working tree."""
+    monkeypatch.setenv("PADDLE_PROFILER_LOG_DIR",
+                       str(tmp_path / "profiler_log"))
+    yield

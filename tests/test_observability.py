@@ -1,0 +1,755 @@
+"""Framework-wide telemetry layer (PR 6): MetricsRegistry golden tests,
+TrainingMonitor unit + wiring tests (hybrid engine / static Executor /
+hapi fit), comm-monitor heartbeat gauges, the xprof_report classifier over
+the checked-in synthetic trace fixture, profiler satellites
+(load_profiler_result, step_info units, chrome-export run suffix), and the
+per-run telemetry JSON artifact. CPU-only, tier-1 safe."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu.observability as obs
+from paddle_tpu.observability import (MetricsRegistry, NonFiniteLossError,
+                                      TrainingMonitor)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "xprof_trace.json")
+
+
+@pytest.fixture
+def fresh_registry():
+    """Swap a fresh registry in as the process-global one so wiring tests
+    observe only their own run."""
+    r = MetricsRegistry()
+    prev = obs.set_global_registry(r)
+    yield r
+    obs.set_global_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counters_and_labels(self):
+        r = MetricsRegistry()
+        r.inc("reqs")
+        r.inc("reqs", 2)
+        r.inc("reqs", labels={"route": "a"})
+        assert r.counter("reqs") == 3
+        assert r.counter("reqs", labels={"route": "a"}) == 1
+        assert r.counter("missing") == 0
+
+    def test_gauge_tracks_running_max(self):
+        r = MetricsRegistry()
+        r.set_gauge("hbm", 100)
+        r.set_gauge("hbm", 40)
+        assert r.gauge("hbm") == 40
+        assert r.snapshot()["gauges"]["hbm"][""]["max"] == 100
+
+    def test_histogram_quantiles_golden(self):
+        # 1..100 into decade buckets: bucket i holds (10i, 10(i+1)], so the
+        # interpolated quantiles are exact integers
+        r = MetricsRegistry()
+        r.declare_histogram("lat", range(10, 101, 10))
+        for v in range(1, 101):
+            r.observe("lat", v)
+        o = r.observation("lat")
+        assert o["count"] == 100 and o["sum"] == 5050
+        assert o["min"] == 1 and o["max"] == 100
+        assert o["mean"] == pytest.approx(50.5)
+        assert o["p50"] == pytest.approx(50.0)
+        assert o["p95"] == pytest.approx(95.0)
+        assert o["p99"] == pytest.approx(99.0)
+
+    def test_histogram_single_value_clamps(self):
+        r = MetricsRegistry()
+        r.observe("x", 0.3)
+        o = r.observation("x")
+        assert o["p50"] == o["p95"] == o["p99"] == pytest.approx(0.3)
+
+    def test_observation_none_when_unobserved(self):
+        assert MetricsRegistry().observation("nope") is None
+
+    def test_prometheus_text_golden(self):
+        r = MetricsRegistry()
+        r.declare_histogram("lat", (0.1, 1, 10))
+        r.inc("reqs", 3, labels={"route": "a"})
+        r.set_gauge("g", 2.5)
+        r.observe("lat", 0.5)
+        r.observe("lat", 5)
+        assert r.to_prometheus() == (
+            "# TYPE reqs counter\n"
+            'reqs{route="a"} 3\n'
+            "# TYPE g gauge\n"
+            "g 2.5\n"
+            "# TYPE lat histogram\n"
+            'lat_bucket{le="0.1"} 0\n'
+            'lat_bucket{le="1"} 1\n'
+            'lat_bucket{le="10"} 2\n'
+            'lat_bucket{le="+Inf"} 2\n'
+            "lat_sum 5.5\n"
+            "lat_count 2\n")
+
+    def test_prometheus_sanitizes_metric_names(self):
+        r = MetricsRegistry()
+        r.inc("train/steps", labels={"source": "x"})
+        text = r.to_prometheus()
+        assert "# TYPE train_steps counter" in text
+        assert 'train_steps{source="x"} 1' in text
+
+    def test_thread_safety(self):
+        r = MetricsRegistry()
+
+        def work():
+            for _ in range(1000):
+                r.inc("c")
+                r.observe("o", 1.0)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert r.counter("c") == 8000
+        assert r.observation("o")["count"] == 8000
+
+    def test_reset_keeps_named_counters(self):
+        r = MetricsRegistry()
+        r.inc("compiles")
+        r.inc("steps")
+        r.set_gauge("g", 1)
+        r.observe("o", 1.0)
+        r.reset(keep_counters=("compiles",))
+        assert r.counter("compiles") == 1
+        assert r.counter("steps") == 0
+        assert r.gauge("g") == 0
+        assert r.observation("o") is None
+
+    def test_timer_observes(self):
+        r = MetricsRegistry()
+        with r.timer("t"):
+            pass
+        assert r.observation("t")["count"] == 1
+
+    def test_snapshot_sorted_and_jsonable(self):
+        r = MetricsRegistry()
+        r.inc("b")
+        r.inc("a")
+        snap = r.snapshot()
+        assert list(snap["counters"]) == ["a", "b"]
+        json.dumps(snap)  # JSON-able
+
+
+# ---------------------------------------------------------------------------
+# serving Metrics facade
+# ---------------------------------------------------------------------------
+
+
+class TestServingMetricsShim:
+    def test_observation_has_quantiles(self):
+        from paddle_tpu.serving.metrics import Metrics
+
+        m = Metrics()
+        for v in (0.1, 0.2, 0.3):
+            m.observe("ttft_s", v)
+        o = m.observation("ttft_s")
+        for k in ("count", "sum", "min", "max", "mean", "p50", "p95", "p99"):
+            assert k in o
+        assert o["count"] == 3
+
+    def test_reset_keeps_compile_counters(self):
+        from paddle_tpu.serving.metrics import Metrics
+
+        m = Metrics()
+        m.inc("prefill_compiles")
+        m.inc("prefills")
+        m.reset(keep_counters=("prefill_compiles",))
+        assert m.counter("prefill_compiles") == 1
+        assert m.counter("prefills") == 0
+
+    def test_summary_shape_and_prometheus(self):
+        from paddle_tpu.serving.metrics import Metrics
+
+        m = Metrics()
+        m.inc("a")
+        m.set_gauge("g", 2)
+        m.observe("o", 1.5)
+        s = m.summary()
+        assert s["counters"] == {"a": 1}
+        assert s["gauges"]["g"]["value"] == 2
+        assert s["observations"]["o"]["mean"] == 1.5
+        assert "# TYPE a counter" in m.to_prometheus()
+
+    def test_own_registry_by_default(self):
+        from paddle_tpu.serving.metrics import Metrics
+
+        a, b = Metrics(), Metrics()
+        a.inc("x")
+        assert b.counter("x") == 0
+
+
+# ---------------------------------------------------------------------------
+# TrainingMonitor
+# ---------------------------------------------------------------------------
+
+
+class TestTrainingMonitor:
+    def test_record_step_tokens_mfu(self):
+        r = MetricsRegistry()
+        mon = TrainingMonitor(registry=r, source="t", flops_per_token=2.0,
+                              peak_flops=1000.0, nan_action="none")
+        stats = mon.record_step(0.5, tokens=100)
+        assert stats["tokens_per_sec"] == pytest.approx(200.0)
+        assert stats["mfu"] == pytest.approx(200.0 * 2.0 / 1000.0)
+        lbl = {"source": "t"}
+        assert r.counter("train/steps", labels=lbl) == 1
+        assert r.observation("train/mfu", labels=lbl)["count"] == 1
+
+    def test_nan_action_raise(self):
+        r = MetricsRegistry()
+        mon = TrainingMonitor(registry=r, source="t", nan_action="raise")
+        mon.start_step()
+        with pytest.raises(NonFiniteLossError):
+            mon.end_step(loss=np.float32("nan"))
+        assert r.counter("train/non_finite_loss",
+                         labels={"source": "t"}) == 1
+
+    def test_nan_action_warn(self):
+        mon = TrainingMonitor(registry=MetricsRegistry(), source="t",
+                              nan_action="warn")
+        mon.start_step()
+        with pytest.warns(RuntimeWarning, match="non-finite loss"):
+            mon.end_step(loss=np.float32("inf"))
+
+    def test_nan_action_none_skips_readback(self):
+        r = MetricsRegistry()
+        mon = TrainingMonitor(registry=r, source="t", nan_action="none")
+        mon.start_step()
+        stats = mon.end_step(loss=np.float32("nan"))  # not even read
+        assert "loss" not in stats
+        assert r.counter("train/non_finite_loss", labels={"source": "t"}) == 0
+
+    def test_nan_action_none_with_explicit_loss_stays_silent(self):
+        # hapi fit hands the host-side loss in directly; 'none' must skip
+        # the check there too (no warning, no counter)
+        import warnings as _w
+
+        r = MetricsRegistry()
+        mon = TrainingMonitor(registry=r, source="t", nan_action="none")
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            stats = mon.record_step(0.1, loss_value=float("nan"))
+        assert stats["loss"] != stats["loss"]  # recorded, NaN
+        assert r.counter("train/non_finite_loss", labels={"source": "t"}) == 0
+
+    def test_invalid_nan_action_rejected(self):
+        with pytest.raises(ValueError):
+            TrainingMonitor(nan_action="explode")
+
+    def test_step_context_manager(self):
+        r = MetricsRegistry()
+        mon = TrainingMonitor(registry=r, source="t", nan_action="none")
+        with mon.step(tokens=10):
+            pass
+        assert r.counter("train/steps", labels={"source": "t"}) == 1
+
+    def test_end_step_without_start_raises(self):
+        with pytest.raises(RuntimeError):
+            TrainingMonitor(registry=MetricsRegistry()).end_step()
+
+    def test_heartbeat_ages_readback(self):
+        r = MetricsRegistry()
+        mon = TrainingMonitor(registry=r, source="t")
+        r.set_gauge("comm/heartbeat_age_s", 0.0, labels={"rank": 0})
+        r.set_gauge("comm/heartbeat_age_s", 3.5, labels={"rank": 1})
+        assert mon.heartbeat_ages() == {0: 0.0, 1: 3.5}
+
+
+# ---------------------------------------------------------------------------
+# wiring: hybrid engine / static Executor / hapi fit / comm monitor
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg():
+    from paddle_tpu.models.llama import LlamaConfig
+
+    return LlamaConfig.tiny(
+        num_hidden_layers=2, hidden_size=32, intermediate_size=64,
+        num_attention_heads=4, vocab_size=128, max_position_embeddings=32)
+
+
+class TestHybridEngineWiring:
+    def test_train_batch_reports_steps_mfu_hbm_compiles(self, fresh_registry):
+        from paddle_tpu.distributed.hybrid_engine import HybridParallelEngine
+
+        eng = HybridParallelEngine(_tiny_cfg(), dp=1, pp=1, mp=1)
+        eng.monitor.peak_flops = 1e12  # CPU auto-detect yields None
+        params, opt = eng.init_state(0)
+        ids = np.random.randint(0, 128, (2, 16)).astype(np.int32)
+        for _ in range(2):
+            loss, params, opt = eng.train_batch(params, opt, ids, ids)
+        snap = fresh_registry.snapshot()
+        lbl = "source=hybrid_engine"
+        assert snap["counters"]["train/steps"][lbl] == 2
+        # one XLA compilation for two same-shape steps (trace-time counter)
+        assert snap["counters"]["train/compiles"][
+            f"kind=train_step,{lbl}"] == 1
+        tps = snap["histograms"]["train/tokens_per_sec"][lbl]
+        assert tps["count"] == 2
+        assert snap["histograms"]["train/mfu"][lbl]["count"] == 2
+        assert "train/hbm_high_water_bytes" in snap["gauges"]
+        # flops_per_token auto-filled from the model args + seq len
+        assert eng.monitor.flops_per_token > 0
+
+    def test_auto_peak_flops_scales_with_mesh_size(self, fresh_registry,
+                                                   monkeypatch):
+        import paddle_tpu.observability.hardware as hw
+        from paddle_tpu.distributed.hybrid_engine import HybridParallelEngine
+
+        # train_batch reports GLOBAL tokens/sec, so the auto MFU
+        # denominator must be per-chip peak x mesh size
+        monkeypatch.setattr(hw, "detect_peak_flops", lambda: 1e12)
+        eng = HybridParallelEngine(_tiny_cfg(), dp=2, pp=1, mp=1)
+        assert eng.monitor.peak_flops == 2e12
+
+    def test_user_flops_per_token_not_overwritten(self, fresh_registry):
+        from paddle_tpu.distributed.hybrid_engine import HybridParallelEngine
+
+        mon = TrainingMonitor(source="custom_fpt", flops_per_token=123.0,
+                              peak_flops=1e12, nan_action="none")
+        eng = HybridParallelEngine(_tiny_cfg(), monitor=mon)
+        params, opt = eng.init_state(0)
+        ids = np.random.randint(0, 128, (2, 16)).astype(np.int32)
+        eng.train_batch(params, opt, ids, ids)
+        # the llama auto-fill must not clobber a user-supplied FLOPs count
+        assert mon.flops_per_token == 123.0
+
+    def test_nan_loss_raises_through_engine(self, fresh_registry):
+        import jax
+
+        from paddle_tpu.distributed.hybrid_engine import HybridParallelEngine
+
+        mon = TrainingMonitor(source="nan_engine", nan_action="raise")
+        eng = HybridParallelEngine(_tiny_cfg(), monitor=mon)
+        params, opt = eng.init_state(0)
+        params = jax.tree.map(lambda a: a * np.float32("nan"), params)
+        ids = np.random.randint(0, 128, (2, 16)).astype(np.int32)
+        with pytest.raises(NonFiniteLossError):
+            eng.train_batch(params, opt, ids, ids)
+
+
+class TestStaticExecutorWiring:
+    def test_run_records_step_and_compile(self, fresh_registry):
+        import paddle_tpu as paddle
+        import paddle_tpu.static as static
+
+        paddle.enable_static()
+        try:
+            with static.program_guard(static.Program(), static.Program()):
+                x = static.data("x", [4, 8], "float32")
+                y = (x * 2.0).sum()
+                exe = static.Executor()
+                feed = {"x": np.ones((4, 8), np.float32)}
+                exe.run(feed=feed, fetch_list=[y])
+                exe.run(feed=feed, fetch_list=[y])  # cached: no new compile
+        finally:
+            paddle.disable_static()
+        snap = fresh_registry.snapshot()
+        lbl = "source=static_executor"
+        assert snap["counters"]["train/steps"][lbl] == 2
+        assert snap["counters"]["train/compiles"][f"kind=infer,{lbl}"] == 1
+        assert snap["histograms"]["train/samples_per_sec"][lbl]["count"] == 2
+
+
+class TestHapiFitWiring:
+    def test_fit_records_steps_and_samples(self, fresh_registry):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.io import TensorDataset
+
+        net = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(learning_rate=0.1,
+                                           parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        X = np.random.randn(16, 4).astype(np.float32)
+        Y = np.random.randint(0, 2, (16, 1)).astype(np.int64)
+        model.fit(TensorDataset([X, Y]), batch_size=4, epochs=1, verbose=0)
+        snap = fresh_registry.snapshot()
+        lbl = "source=hapi_fit"
+        assert snap["counters"]["train/steps"][lbl] == 4
+        assert snap["histograms"]["train/samples_per_sec"][lbl]["count"] == 4
+        # the loss gauge proves the (already-host) loss fed the NaN monitor
+        assert "train/loss" in snap["gauges"]
+
+
+class _FakeStore:
+    def __init__(self):
+        self.d = {}
+
+    def set(self, k, v):
+        self.d[k] = v
+
+    def get(self, k, timeout=None):
+        return self.d[k]
+
+
+class TestCommMonitorWiring:
+    def test_heartbeat_gauges_and_dead_rank_counter(self, fresh_registry):
+        import time as _time
+
+        from paddle_tpu.distributed.comm_monitor import CommMonitor
+
+        store = _FakeStore()
+        store.set("hb/1", "t0")  # peer heartbeats once, then goes silent
+        mon = CommMonitor(store, rank=0, world_size=2,
+                          heartbeat_interval=0.05, miss_limit=2,
+                          registry=fresh_registry)
+        try:
+            deadline = _time.time() + 3.0
+            while (fresh_registry.counter("comm/ranks_declared_dead") == 0
+                   and _time.time() < deadline):
+                _time.sleep(0.05)
+            # the dead rank's age gauge must keep advancing, not freeze at
+            # the value it had when the rank was declared dead
+            age_at_death = fresh_registry.gauge("comm/heartbeat_age_s",
+                                                labels={"rank": 1})
+            deadline = _time.time() + 3.0
+            while (fresh_registry.gauge("comm/heartbeat_age_s",
+                                        labels={"rank": 1}) <= age_at_death
+                   and _time.time() < deadline):
+                _time.sleep(0.05)
+            assert fresh_registry.gauge(
+                "comm/heartbeat_age_s", labels={"rank": 1}) > age_at_death
+        finally:
+            mon.stop()
+        # own heartbeat gauge is 0 (we just wrote it), peer's age grew past
+        # the grace period and the rank was declared dead
+        assert fresh_registry.gauge("comm/heartbeat_age_s",
+                                    labels={"rank": 0}) == 0.0
+        snap = fresh_registry.snapshot()
+        ages = snap["gauges"]["comm/heartbeat_age_s"]
+        assert "rank=1" in ages and ages["rank=1"]["value"] > 0
+        assert fresh_registry.counter("comm/ranks_declared_dead") == 1
+        assert 1 in mon.failed_ranks
+
+    def test_never_heartbeated_dead_rank_still_gets_age_gauge(
+            self, fresh_registry):
+        import time as _time
+
+        from paddle_tpu.distributed.comm_monitor import CommMonitor
+
+        # peer NEVER writes hb/1: its age gauge (from monitor start) must
+        # exist while the startup grace window is still running, and keep
+        # existing/advancing once the rank is declared dead
+        mon = CommMonitor(_FakeStore(), rank=0, world_size=2,
+                          heartbeat_interval=0.02, miss_limit=2,
+                          registry=fresh_registry)
+        try:
+            deadline = _time.time() + 5.0
+            while (fresh_registry.gauge("comm/heartbeat_age_s",
+                                        labels={"rank": 1}) == 0.0
+                   and _time.time() < deadline):
+                _time.sleep(0.02)
+            visible_before_death = (
+                fresh_registry.counter("comm/ranks_declared_dead") == 0)
+            deadline = _time.time() + 5.0
+            while (fresh_registry.counter("comm/ranks_declared_dead") == 0
+                   and _time.time() < deadline):
+                _time.sleep(0.05)
+        finally:
+            mon.stop()
+        assert visible_before_death  # gauge existed during the grace window
+        assert 1 in mon.failed_ranks
+        assert fresh_registry.gauge("comm/heartbeat_age_s",
+                                    labels={"rank": 1}) > 0
+
+
+# ---------------------------------------------------------------------------
+# xprof report
+# ---------------------------------------------------------------------------
+
+
+class TestXprofReport:
+    def test_classify(self):
+        import tools.xprof_report as xr
+
+        assert xr.classify("dot.5") == "matmul"
+        assert xr.classify("%convolution.2") == "matmul"
+        assert xr.classify("all-reduce-start.1") == "collective"
+        assert xr.classify("reduce-scatter.7") == "collective"
+        assert xr.classify("collective-permute.1") == "collective"
+        assert xr.classify("copy.3") == "copy-infeed"
+        assert xr.classify("infeed.1") == "copy-infeed"
+        assert xr.classify("fusion.12") == "vector"
+        assert xr.classify("loop_add_fusion.2") == "vector"
+        # HLO dtype casts are NOT matmuls ("conv" substring trap)
+        assert xr.classify("convert.5") == "vector"
+        assert xr.classify("%convert.17") == "vector"
+        # collectives win over matmul-ish substrings
+        assert xr.classify("all-reduce-dot-fusion") == "collective"
+
+    def test_report_golden_on_fixture(self):
+        import tools.xprof_report as xr
+
+        rep = xr.build_report(xr.load_events(FIXTURE), top_k=5)
+        assert rep["devices"] == 1
+        # op time: 100+300+200+40+350+50+100 us
+        assert rep["device_time_s"] == pytest.approx(1140e-6)
+        # busy union [0,450]+[460,500]+[550,1000] over the 1000us span
+        assert rep["device_busy_pct"] == pytest.approx(94.0)
+        # all-reduce [250,450] overlaps compute [0,400] for 150 of 200us
+        assert rep["comm_compute_overlap_pct"] == pytest.approx(75.0)
+        c = rep["classes"]
+        assert c["matmul"]["seconds"] == pytest.approx(650e-6)
+        assert c["collective"]["seconds"] == pytest.approx(200e-6)
+        assert c["vector"]["seconds"] == pytest.approx(250e-6)
+        assert c["copy-infeed"]["seconds"] == pytest.approx(40e-6)
+        # the Steps lane lands in "other"; the XLA Modules span is excluded.
+        # Its share is of the SPAN (it brackets the ops), so the four op
+        # classes sum to 100% of device time on their own
+        assert c["other"]["seconds"] == pytest.approx(1000e-6)
+        assert c["other"]["pct_of_span"] == pytest.approx(100.0)
+        assert sum(c[k]["pct_of_device"]
+                   for k in ("matmul", "collective", "vector",
+                             "copy-infeed")) == pytest.approx(100.0, abs=0.1)
+        assert [r["name"] for r in c["matmul"]["top"]] == ["dot.2", "dot.1"]
+        top_nm = rep["top_non_matmul"]
+        # the fixture carries 5 non-matmul ops so top-5 is fully exercised
+        assert len(top_nm) == 5
+        assert top_nm[0]["name"] == "all-reduce.1"
+        assert top_nm[0]["class"] == "collective"
+        assert top_nm[0]["pct_of_device"] == pytest.approx(17.54, abs=0.01)
+        assert all(r["class"] != "matmul" for r in top_nm)
+
+    def test_cli_prints_and_writes_json(self, tmp_path, capsys):
+        import tools.xprof_report as xr
+
+        out_json = tmp_path / "rep.json"
+        rc = xr.main([FIXTURE, "--top", "3", "--json", str(out_json)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "device-busy: 94.0%" in out
+        assert "comm-compute overlap: 75.0%" in out
+        assert "top-3 non-matmul consumers" in out
+        rep = json.loads(out_json.read_text())
+        assert rep["device_busy_pct"] == 94.0
+        assert len(rep["top_non_matmul"]) <= 3
+
+    def test_empty_trace_fails_loud(self, tmp_path):
+        import tools.xprof_report as xr
+
+        p = tmp_path / "empty.json"
+        p.write_text('{"traceEvents": []}')
+        assert xr.main([str(p)]) == 1
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites
+# ---------------------------------------------------------------------------
+
+
+class TestProfilerSatellites:
+    def test_step_info_unit(self):
+        from paddle_tpu.profiler import Profiler
+
+        p = Profiler(timer_only=True)
+        p._step_times = [0.002, 0.004]
+        assert p.step_info() == "avg step time 3.00 ms over 2 steps"
+        assert p.step_info(unit="us") == "avg step time 3000.00 us over 2 steps"
+        assert p.step_info(unit="s") == "avg step time 0.00 s over 2 steps"
+        with pytest.raises(ValueError):
+            p.step_info(unit="ns")
+
+    def test_default_log_dir_routed_through_env(self):
+        from paddle_tpu.profiler import Profiler
+
+        # the autouse fixture points PADDLE_PROFILER_LOG_DIR at tmp_path
+        assert (Profiler(timer_only=True).log_dir
+                == os.environ["PADDLE_PROFILER_LOG_DIR"])
+        assert Profiler(timer_only=True,
+                        log_dir="./explicit").log_dir == "./explicit"
+
+    def test_export_chrome_tracing_suffixes_runs(self, tmp_path):
+        from paddle_tpu.profiler import export_chrome_tracing
+
+        class _Prof:
+            def export_chrome_trace(self, path):
+                with open(path, "w") as f:
+                    json.dump({"traceEvents": []}, f)
+
+        handler = export_chrome_tracing(str(tmp_path), worker_name="worker")
+        handler(_Prof())
+        handler(_Prof())
+        handler(_Prof())
+        assert sorted(os.listdir(tmp_path)) == [
+            "worker.json", "worker_1.json", "worker_2.json"]
+
+    def test_load_profiler_result_roundtrip(self, tmp_path):
+        from paddle_tpu.profiler import Profiler, load_profiler_result
+
+        p = Profiler(timer_only=True)
+        # fabricate a finished session: two host op dispatches + two device
+        # lane events (an op + a module span)
+        p._records = [("matmul", 10.0, 0.002), ("matmul", 10.1, 0.004),
+                      ("relu", 10.2, 0.001)]
+        p._device_raw = [
+            {"name": "dot.1", "ts": 0.0, "dur": 500.0, "lane": "XLA Ops"},
+            {"name": "jit_step", "ts": 0.0, "dur": 800.0,
+             "lane": "XLA Modules"},
+        ]
+        path = str(tmp_path / "trace.json")
+        p.export_chrome_trace(path)
+
+        res = load_profiler_result(path)
+        ops = res.statistic_data.ops
+        assert ops["matmul"].calls == 2
+        assert ops["matmul"].total == pytest.approx(0.006)
+        assert ops["relu"].calls == 1
+        dev = res.statistic_data.device
+        assert dev["dot.1"].total == pytest.approx(500e-6)
+        # module span sets device_total, not a per-op row
+        assert "jit_step" not in dev
+        assert res.statistic_data.device_total == pytest.approx(800e-6)
+        table = res.summary()
+        assert "matmul" in table
+
+    def test_load_profiler_result_gzipped_trace(self, tmp_path):
+        import gzip
+
+        from paddle_tpu.profiler import load_profiler_result
+
+        # the *.trace.json.gz shape xprof writes under plugins/profile/
+        trace = {"traceEvents": [
+            {"ph": "X", "cat": "device", "name": "dot.1", "ts": 0,
+             "dur": 400, "args": {"lane": "XLA Ops"}},
+        ]}
+        path = str(tmp_path / "host.trace.json.gz")
+        with gzip.open(path, "wt") as f:
+            json.dump(trace, f)
+        res = load_profiler_result(path)
+        assert res.statistic_data.device["dot.1"].total == pytest.approx(
+            400e-6)
+
+    def test_load_profiler_result_raw_xprof_trace(self):
+        from paddle_tpu.profiler import load_profiler_result
+
+        # a raw xprof dump has no cat:"op"/"device" events — lanes come
+        # from process_name/thread_name metadata; the loader must fall
+        # back to the xprof parser instead of returning an empty result
+        res = load_profiler_result(FIXTURE)
+        dev = res.statistic_data.device
+        assert "dot.1" in dev and "all-reduce.1" in dev
+        assert res.statistic_data.device_total > 0
+
+    def test_load_profiler_result_missing_file_raises(self, tmp_path):
+        from paddle_tpu.profiler import load_profiler_result
+
+        with pytest.raises(OSError):
+            load_profiler_result(str(tmp_path / "nope.json"))
+
+
+# ---------------------------------------------------------------------------
+# telemetry artifacts
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryArtifacts:
+    def test_write_run_telemetry_payload(self, tmp_path):
+        from paddle_tpu.observability import SCHEMA, write_run_telemetry
+
+        r = MetricsRegistry()
+        r.inc("train/steps")
+        path = tmp_path / "t" / "run.json"
+        payload = write_run_telemetry(path, record={"tps": 123.0},
+                                      registry=r, meta={"tool": "test"})
+        on_disk = json.loads(path.read_text())
+        assert on_disk["schema"] == SCHEMA
+        assert on_disk["record"] == {"tps": 123.0}
+        assert on_disk["meta"] == {"tool": "test"}
+        assert on_disk["metrics"]["counters"]["train/steps"][""] == 1
+        assert payload["schema"] == SCHEMA
+        assert not os.path.exists(str(path) + ".tmp")  # atomic rename
+        # empty legs dict -> no metrics_by_leg key
+        assert "metrics_by_leg" not in on_disk
+
+    def test_write_run_telemetry_merges_leg_snapshots(self, tmp_path):
+        from paddle_tpu.observability import write_run_telemetry
+
+        # bench main() runs legs in child processes and merges their
+        # registry snapshots — the parent's registry never saw those runs
+        r = MetricsRegistry()
+        r.inc("train/steps")
+        path = tmp_path / "run.json"
+        write_run_telemetry(path, record={},
+                            legs={"h64_b8": r.snapshot()})
+        on_disk = json.loads(path.read_text())
+        assert on_disk["metrics_by_leg"]["h64_b8"][
+            "counters"]["train/steps"][""] == 1
+
+    def test_bench_telemetry_flag_parse_and_write(self, tmp_path):
+        import bench
+
+        argv, out = bench._parse_argv(
+            ["--serving", "--telemetry-out", "x.json"])
+        assert argv == ["--serving"] and out == "x.json"
+        argv, out = bench._parse_argv(["--int8"])
+        assert argv == ["--int8"] and out is None
+
+        path = tmp_path / "bench.json"
+        bench.write_telemetry(str(path), {"metric": "m", "value": 1.0})
+        on_disk = json.loads(path.read_text())
+        assert on_disk["record"]["metric"] == "m"
+        assert on_disk["meta"]["tool"] == "bench"
+        assert "metrics" in on_disk
+
+    def test_dryrun_telemetry_env_gate(self, tmp_path, monkeypatch):
+        import __graft_entry__ as ge
+
+        monkeypatch.delenv("PADDLE_TELEMETRY_OUT", raising=False)
+        assert ge._maybe_write_dryrun_telemetry({"x": 1}) is None
+
+        path = tmp_path / "dryrun.json"
+        monkeypatch.setenv("PADDLE_TELEMETRY_OUT", str(path))
+        payload = ge._maybe_write_dryrun_telemetry(
+            {"schedule_step_ms": {"gpipe": 1.0}})
+        assert payload is not None
+        on_disk = json.loads(path.read_text())
+        assert on_disk["record"]["schedule_step_ms"] == {"gpipe": 1.0}
+        assert on_disk["meta"]["tool"] == "dryrun_multichip"
+
+
+# ---------------------------------------------------------------------------
+# serving TTFT seconds + steps
+# ---------------------------------------------------------------------------
+
+
+class TestServingTTFT:
+    def test_engine_records_ttft_in_seconds_and_steps(self):
+        import jax
+
+        from paddle_tpu.models import llama_functional as lf
+        from paddle_tpu.serving import Engine, Request
+
+        args = lf.LlamaArgs(vocab_size=64, hidden_size=32,
+                            intermediate_size=64, num_layers=1, num_heads=2,
+                            num_kv_heads=1, rope_theta=1e4, rms_eps=1e-6,
+                            use_flash=False)
+        params = lf.init_params(args, jax.random.key(0))
+        eng = Engine(params, args, max_slots=2, max_len=32, min_bucket=4)
+        req = eng.submit(Request(np.array([1, 2, 3], np.int32),
+                                 max_new_tokens=4))
+        eng.run_until_idle()
+        assert req.ttft_s is not None and req.ttft_s >= 0
+        assert req.ttft_steps is not None and req.ttft_steps >= 0
+        sec = eng.metrics.observation("ttft_s")
+        steps = eng.metrics.observation("ttft_steps")
+        assert sec["count"] == 1 and steps["count"] == 1
+        assert "p99" in sec  # ROADMAP 2's acceptance metric is a p99
