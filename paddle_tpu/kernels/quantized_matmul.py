@@ -46,8 +46,9 @@ from jax.experimental.pallas import tpu as pltpu
 from paddle_tpu.kernels.flash_attention import _pick_block
 
 __all__ = ["fused_dequant_matmul", "weight_only_matmul", "decode_attention",
-           "fused_dispatch", "fused_enabled", "matmul_supported",
-           "decode_supported", "quantize_absmax"]
+           "paged_decode_attention", "paged_gather", "fused_dispatch",
+           "fused_enabled", "matmul_supported", "decode_supported",
+           "paged_decode_supported", "quantize_absmax"]
 
 _NEG_INF = -1e30
 
@@ -341,6 +342,163 @@ def _decode_attention_xla(q, cache_k, cache_v, pos, sm_scale):
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(cache_v.dtype), cache_v)
     return jnp.swapaxes(attn, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention (single query vs a page pool through a block table)
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(pos_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, page_size, sm_scale):
+    # grid (b, nkv, P): the innermost dim walks the row's block table; the
+    # k/v BlockSpec index maps read bt_ref (scalar-prefetched) so each step
+    # DMAs the PAGE the table points at — the gather never materializes a
+    # contiguous cache. Online max/sum state lives in VMEM scratch because
+    # it must survive across grid steps (the non-paged kernel keeps it in
+    # registers inside one fori_loop).
+    bi, j = pl.program_id(0), pl.program_id(2)
+    pos = pos_ref[bi]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # pages past the row's position watermark are skipped entirely (their
+    # index map re-points at the watermark page, so no fresh DMA either)
+    @pl.when(j * page_size <= pos)
+    def _page():
+        q = q_ref[0, 0]                       # [g, d]
+        k = k_ref[0, 0]                       # [page_size, d]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale   # [g, ps]
+        cols = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols <= pos, s, _NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_decode_supported(q_shape, pool_shape, bt_shape, itemsize=2):
+    """True when the Pallas paged kernel can take q [b, 1, nh, hd] against
+    a page pool [num_pages, nkv, page_size, hd] via block tables [b, P]:
+    single query, query heads a multiple of kv heads, page_size a
+    sublane-tileable multiple and hd lane-aligned, working set in VMEM."""
+    if len(q_shape) != 4 or q_shape[1] != 1:
+        return False
+    if len(pool_shape) != 4 or len(bt_shape) != 2:
+        return False
+    b, nh, hd = q_shape[0], q_shape[2], q_shape[3]
+    nkv, ps, hd2 = pool_shape[1], pool_shape[2], pool_shape[3]
+    if hd2 != hd or nkv <= 0 or nh % nkv != 0 or bt_shape[0] != b:
+        return False
+    min_sublane = 32 // max(int(itemsize), 1)   # f32: 8, bf16: 16
+    if ps % min_sublane != 0 or hd % 128 != 0:
+        return False
+    per_step = 2 * 2 * ps * hd * itemsize      # k + v page, double-buffered
+    return per_step <= _VMEM_BUDGET_BYTES
+
+
+def _paged_decode_attention_pallas(q, pool_k, pool_v, block_tables, pos,
+                                   sm_scale, interpret):
+    b, _, nh, hd = q.shape
+    nkv, ps = pool_k.shape[1], pool_k.shape[2]
+    P = block_tables.shape[1]
+    g = nh // nkv
+    q4 = q[:, 0].reshape(b, nkv, g, hd)
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+    bt_arr = jnp.asarray(block_tables, jnp.int32)
+
+    def kv_map(bi, hi, j, pos_ref, bt_ref):
+        # clamp to the watermark page: steps past the row's valid prefix
+        # keep mapping the same block, so Pallas elides the re-fetch
+        jj = jnp.minimum(j, pos_ref[bi] // ps)
+        return (bt_ref[bi, jj], hi, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, P),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, hd),
+                         lambda bi, hi, j, pos_ref, bt_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, ps, hd), kv_map),
+            pl.BlockSpec((1, 1, ps, hd), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, hd),
+                               lambda bi, hi, j, pos_ref, bt_ref:
+                               (bi, hi, 0, 0)),
+        scratch_shapes=[pltpu.VMEM((g, hd), jnp.float32),
+                        pltpu.VMEM((g, 1), jnp.float32),
+                        pltpu.VMEM((g, 1), jnp.float32)],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page_size=ps,
+                          sm_scale=sm_scale),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(pos_arr, bt_arr, q4, pool_k, pool_v)
+    return out.reshape(b, nh, hd)[:, None]
+
+
+def paged_gather(pool, block_tables):
+    """Gather a pool [num_pages, nkv, page_size, hd] through block tables
+    [b, P] into the contiguous per-row cache layout [b, nkv, P*ps, hd] —
+    the jnp fallback path and the parity oracle for the paged kernel
+    (pages laid out in table order ARE the row's sequence)."""
+    b, P = block_tables.shape
+    nkv, ps, hd = pool.shape[1], pool.shape[2], pool.shape[3]
+    g = jnp.swapaxes(pool[block_tables], 1, 2)   # [b, nkv, P, ps, hd]
+    return g.reshape(b, nkv, P * ps, hd)
+
+
+def _paged_decode_attention_xla(q, pool_k, pool_v, block_tables, pos,
+                                sm_scale):
+    return _decode_attention_xla(q, paged_gather(pool_k, block_tables),
+                                 paged_gather(pool_v, block_tables),
+                                 pos, sm_scale)
+
+
+def paged_decode_attention(q, pool_k, pool_v, block_tables, pos, scale=None):
+    """Single-query attention of q [b, 1, nh, hd] over a PAGED KV cache:
+    pool_k/pool_v [num_pages, nkv, page_size, hd] indexed through per-row
+    block tables [b, P] (page i of row r holds that row's positions
+    [i*ps, (i+1)*ps)), valid prefix [0, pos[r]]. Unused table entries may
+    point anywhere valid (the null page); the position mask keeps them
+    unread. Pallas on TPU (per-row page-index prefetch: the block-table
+    lookup happens in the BlockSpec index map, so K/V stream page-by-page
+    straight from HBM with no contiguous copy), jnp gather elsewhere."""
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    use_pallas, interpret = _mode()
+    if use_pallas and paged_decode_supported(q.shape, pool_k.shape,
+                                             jnp.shape(block_tables),
+                                             q.dtype.itemsize):
+        try:
+            return _paged_decode_attention_pallas(
+                q, pool_k, pool_v, block_tables, pos, sm_scale, interpret)
+        except Exception as e:  # lowering constraints supports() can't model
+            import warnings
+
+            warnings.warn(
+                f"Pallas paged decode attention failed ({type(e).__name__}: "
+                f"{e}); falling back to the XLA gather for q={q.shape} "
+                f"pool={pool_k.shape}")
+    return _paged_decode_attention_xla(q, pool_k, pool_v, block_tables, pos,
+                                       sm_scale)
 
 
 def decode_attention(q, cache_k, cache_v, pos, scale=None, block_k=512):

@@ -39,8 +39,8 @@ from typing import NamedTuple
 from paddle_tpu.models import llama_functional as lf
 
 __all__ = ["generate", "params_from_layer", "prefill", "decode_step",
-           "gpt_generate", "gpt_params_from_layer", "GPTGenArgs",
-           "QuantizedWeight", "quantize_params"]
+           "paged_decode_step", "gpt_generate", "gpt_params_from_layer",
+           "GPTGenArgs", "QuantizedWeight", "quantize_params"]
 
 
 class QuantizedWeight(NamedTuple):
@@ -248,6 +248,98 @@ def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args,
         hl = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0, :]
     logits = _wmm(hl, params["lm_head"])
     return logits.astype(jnp.float32), new_k, new_v
+
+
+def _layer_step_paged(lp, h, pool_k_l, pool_v_l, bt, pos, cos, sin, args,
+                      page_size):
+    """One decoder layer's decode step (s == 1) over a PAGED KV cache.
+
+    pool_k_l/pool_v_l: this layer's page pool [num_pages, nkv, ps, hd];
+    bt: int32 block tables [b, P] (page i of row r holds positions
+    [i*ps, (i+1)*ps) of that row — unused entries point at the null page);
+    pos: int32 [b] per-row write positions. Each row's new k/v is
+    SCATTERED to (bt[r, pos[r]//ps], pos[r] % ps) — write-before-attend,
+    like the stripe path — then attention gathers K/V through the block
+    table (Pallas paged kernel on TPU, jnp gather elsewhere)."""
+    b, s = h.shape[0], h.shape[1]
+    if s != 1:
+        raise ValueError(f"paged decode requires s == 1 (got s={s})")
+    nh = args.num_heads
+    nkv = args.num_kv_heads
+    hd = args.hidden_size // nh
+    ps = page_size
+
+    hin = lf.rms_norm(h, lp["ln1"], args.rms_eps)
+    q = _wmm(hin, lp["wq"]).reshape(b, 1, nh, hd)
+    k = _wmm(hin, lp["wk"]).reshape(b, 1, nkv, hd)
+    v = _wmm(hin, lp["wv"]).reshape(b, 1, nkv, hd)
+    q, k = _rope_rows(q, k, jnp.take(cos, pos, axis=0),
+                      jnp.take(sin, pos, axis=0))
+
+    # per-row scatter into the pool: rows own their tail page exclusively
+    # (the host-side COW gate guarantees it), so writes never collide on a
+    # live page
+    page = jnp.take_along_axis(bt, (pos // ps)[:, None], axis=1)[:, 0]
+    off = pos % ps
+    pool_k_l = pool_k_l.at[page, :, off].set(k[:, 0])
+    pool_v_l = pool_v_l.at[page, :, off].set(v[:, 0])
+
+    from paddle_tpu.kernels import quantized_matmul as qm
+
+    if qm.fused_enabled() and qm.paged_decode_supported(
+            q.shape, pool_k_l.shape, bt.shape, q.dtype.itemsize):
+        attn = qm.paged_decode_attention(q, pool_k_l, pool_v_l, bt, pos)
+    else:
+        # gather pages into the contiguous per-row layout and reuse the
+        # stripe attention (jnp mask fallback; contiguous Pallas kernel if
+        # eligible) — table order IS sequence order, so positions line up
+        attn = _cached_attention(q, qm.paged_gather(pool_k_l, bt),
+                                 qm.paged_gather(pool_v_l, bt), pos)
+    h = h + _wmm(attn.reshape(b, 1, nh * hd), lp["wo"])
+
+    hin = lf.rms_norm(h, lp["ln2"], args.rms_eps)
+    act = jax.nn.silu(_wmm(hin, lp["w_gate"])) * _wmm(hin, lp["w_up"])
+    h = h + _wmm(act, lp["w_down"])
+    return h, pool_k_l, pool_v_l
+
+
+def _paged_forward_decode(params, ids, pool_k, pool_v, bt, pos, cos, sin,
+                          args, page_size):
+    """ids [b, 1] -> (next-token logits [b, vocab], new pools). The paged
+    analogue of `_forward_cached`'s decode step: pools are [L, num_pages,
+    nkv, ps, hd] and slice per layer under the same lax.scan."""
+    h = jnp.take(params["embedding"], ids, axis=0)
+
+    def step(carry, xs):
+        h = carry
+        lp, pk, pv = xs
+        h, pk, pv = _layer_step_paged(lp, h, pk, pv, bt, pos, cos, sin,
+                                      args, page_size)
+        return h, (pk, pv)
+
+    h, (new_k, new_v) = jax.lax.scan(step, h,
+                                     (params["layers"], pool_k, pool_v))
+    h = lf.rms_norm(h, params["final_norm"], args.rms_eps)
+    logits = _wmm(h[:, -1, :], params["lm_head"])
+    return logits.astype(jnp.float32), new_k, new_v
+
+
+def paged_decode_step(params, args, token, pool_k, pool_v, block_tables,
+                      pos, page_size):
+    """One continuous-batching decode step over a paged KV cache: token
+    [b] at per-row positions pos [b], K/V stored as pages [L, num_pages,
+    nkv, page_size, hd] indexed through block_tables [b, P]. Rows are
+    independent; unused/inactive table entries must point at a valid page
+    index (conventionally the null page 0) and are never read thanks to
+    the position mask. float and `quantize_params` int8 trees both work —
+    every matmul rides the fused dequant-matmul dispatch."""
+    hd = args.hidden_size // args.num_heads
+    P = block_tables.shape[1]
+    cos, sin = lf.rope_tables(P * int(page_size), hd, args.rope_theta)
+    return _paged_forward_decode(
+        params, jnp.asarray(token)[:, None], pool_k, pool_v,
+        jnp.asarray(block_tables, jnp.int32), jnp.asarray(pos, jnp.int32),
+        cos, sin, args, int(page_size))
 
 
 def _sample(logits, sample, temperature, top_p, key):

@@ -1,28 +1,46 @@
 """paddle_tpu.serving — continuous-batching LLM serving.
 
-`Engine` schedules requests at iteration granularity over a slot-based
-KV cache (`serving/engine.py`); `serving/scheduler.py` holds the
-admission queue / length buckets / slot table; `serving/metrics.py` the
-counters (queue depth, TTFT, tokens/sec, slot occupancy, compile counts)
-that also back `inference.Config.enable_profile()`.
+Two engines share one iteration-level scheduler (Orca-style):
 
-    from paddle_tpu.serving import Engine, Request
+  - `Engine` (serving/engine.py): slot-based KV cache — one `max_len`
+    stripe per slot. Simple, but HBM caps concurrency at S stripes.
+  - `PagedEngine` (serving/paged_engine.py): paged KV cache — a fixed
+    page pool + per-slot block tables (`serving/block_manager.py`:
+    refcounted pages, copy-on-write, LRU eviction) with HASH-BASED
+    PREFIX REUSE: full pages of every prefilled prompt are registered in
+    an exact-match hash chain, so a shared system prompt is prefilled
+    once and later requests start decoding after a block-table lookup.
+    Admission allocates pages on demand (worst case reserved up front),
+    so far more concurrent requests fit the same KV HBM.
 
-    eng = Engine(params, args, max_slots=8, max_len=512)
+`serving/scheduler.py` holds the admission queue / length buckets /
+slot table / page math; `serving/metrics.py` the counters (queue depth,
+TTFT, tokens/sec, occupancy, compile counts, prefix-cache hit rate,
+pages in use/free, COW copies) that also back
+`inference.Config.enable_profile()`.
+
+    from paddle_tpu.serving import PagedEngine, Request
+
+    eng = PagedEngine(params, args, max_slots=32, max_len=1024,
+                      page_size=64, num_pages=256)
     req = eng.submit(Request(prompt_ids, max_new_tokens=64,
                              eos_token_id=2, stream_cb=on_token))
     eng.run_until_idle()          # req.token_ids, req.ttft_s, ...
     print(eng.metrics.summary())
 
-`bench.py --serving` replays a deterministic Poisson-ish arrival trace
-(`tools/serving_trace.py`) and reports throughput + TTFT against
-sequential `generate`.
+`bench.py --serving` replays deterministic arrival traces
+(`tools/serving_trace.py`, incl. shared-prefix traces) and reports
+throughput + TTFT vs sequential `generate`, plus a stripe-vs-paged
+comparison at equal KV-cache HBM.
 """
 
+from paddle_tpu.serving.block_manager import NULL_PAGE, BlockAllocator
 from paddle_tpu.serving.engine import Engine, Request
 from paddle_tpu.serving.metrics import Metrics
+from paddle_tpu.serving.paged_engine import PagedEngine
 from paddle_tpu.serving.scheduler import (AdmissionQueue, SlotTable,
-                                          bucket_for)
+                                          bucket_for, pages_for)
 
-__all__ = ["Engine", "Request", "Metrics", "AdmissionQueue", "SlotTable",
-           "bucket_for"]
+__all__ = ["Engine", "PagedEngine", "Request", "Metrics", "BlockAllocator",
+           "NULL_PAGE", "AdmissionQueue", "SlotTable", "bucket_for",
+           "pages_for"]

@@ -1,0 +1,241 @@
+"""Page-pool allocator + hash-chained prefix cache for the paged KV cache.
+
+The paged serving engine (`serving/paged_engine.py`) replaces per-slot
+`max_len` KV stripes with a fixed pool of PAGES
+(`[L, num_pages, nkv, page_size, hd]`) and a per-slot block table — the
+vLLM PagedAttention (Kwon et al., SOSP'23) memory model. This module is
+the host-side brain of that cache; nothing here touches device arrays:
+
+  - `BlockAllocator` hands out page ids from a free list with REFCOUNTS,
+    so one physical page can back many slots (a shared system prompt is
+    resident once);
+  - the PREFIX CACHE is a hash-chained table keyed on
+    `(parent_page_id, page_of_token_ids)` — exact-match chaining (the
+    dict compares the actual token tuples, so there are no hash-collision
+    false hits, the failure mode RadixAttention-style token hashing has
+    to re-verify against). Walking the chain from the root yields the
+    longest cached full-page prefix of a new prompt;
+  - pages whose refcount drops to zero but that remain hash-registered
+    become EVICTABLE instead of free: they keep their contents and can be
+    revived by a later prefix hit, or reclaimed in LRU order when the
+    free list runs dry. Evicting a page orphans its hash descendants
+    (their chain key embeds the evicted page's id, which a recycled page
+    would otherwise spoof into serving stale contents);
+  - `ensure_writable` is the COPY-ON-WRITE gate: writing into a page that
+    is shared (refcount > 1) or hash-registered would corrupt the other
+    readers, so the writer gets a fresh page and the caller copies the
+    device contents across.
+
+Page id 0 is the NULL page: never allocated, a garbage sink for inactive
+block-table rows and a safe gather target for unused entries (the
+position mask keeps it unread on every real path).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+__all__ = ["BlockAllocator", "NULL_PAGE"]
+
+NULL_PAGE = 0
+
+
+class BlockAllocator:
+    """Host-side page allocator with refcounts, prefix-hash reuse, LRU
+    eviction of cached pages, and copy-on-write. Single-threaded — called
+    only from the engine's scheduler loop between device steps."""
+
+    def __init__(self, num_pages, page_size, metrics=None):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the null page)")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self._metrics = metrics
+        self._free = list(range(self.num_pages - 1, 0, -1))  # pop -> lowest
+        self._ref = {}              # page -> refcount (>= 1)
+        self._cached = OrderedDict()  # refcount-0 registered pages, LRU order
+        self._table = {}            # (parent_page | -1, tokens tuple) -> page
+        self._key_of = {}           # registered page -> its table key
+        self._parent = {}           # registered page -> parent page (or -1)
+        self._children = {}         # page -> set of registered child pages
+        self._gauges()
+
+    # -- introspection ------------------------------------------------------
+    @property
+    def capacity(self):
+        """Allocatable pages (the null page excluded)."""
+        return self.num_pages - 1
+
+    @property
+    def free_count(self):
+        return len(self._free)
+
+    @property
+    def available(self):
+        """Pages an alloc() can obtain: free + evictable cached."""
+        return len(self._free) + len(self._cached)
+
+    @property
+    def pages_in_use(self):
+        return len(self._ref)
+
+    def refcount(self, page):
+        return self._ref.get(page, 0)
+
+    def is_registered(self, page):
+        return page in self._key_of
+
+    def _gauges(self):
+        if self._metrics is not None:
+            self._metrics.set_gauge("pages_in_use", len(self._ref))
+            self._metrics.set_gauge("pages_free", self.available)
+
+    # -- alloc / ref / release ---------------------------------------------
+    def alloc(self):
+        """Take an exclusive page (refcount 1): from the free list, else by
+        evicting the least-recently-used cached page. Raises when the pool
+        is exhausted."""
+        if self._free:
+            p = self._free.pop()
+        elif self._cached:
+            p = self._evict_lru()
+        else:
+            raise RuntimeError(
+                f"KV page pool exhausted ({self.capacity} pages, "
+                f"{len(self._ref)} in use) — admission should have gated "
+                f"this request")
+        self._ref[p] = 1
+        self._gauges()
+        return p
+
+    def ref(self, page):
+        """Add a reader. Reviving a cached (refcount-0) page pulls it off
+        the eviction list but keeps its hash registration — the prefix-hit
+        path."""
+        if page == NULL_PAGE:
+            raise ValueError("cannot ref the null page")
+        if page in self._ref:
+            self._ref[page] += 1
+        elif page in self._cached:
+            del self._cached[page]
+            self._ref[page] = 1
+        else:
+            raise KeyError(f"ref of unallocated page {page}")
+        self._gauges()
+
+    def release(self, page):
+        """Drop a reader. At refcount 0 a hash-registered page becomes
+        evictable (contents kept for future prefix hits, most recent at the
+        back of the LRU); an unregistered page returns to the free list."""
+        if page == NULL_PAGE:
+            return
+        r = self._ref[page] - 1
+        if r > 0:
+            self._ref[page] = r
+            return
+        del self._ref[page]
+        if page in self._key_of:
+            self._cached[page] = True       # most-recently-used position
+            self._cached.move_to_end(page)
+        else:
+            self._free.append(page)
+        self._gauges()
+
+    # -- copy-on-write ------------------------------------------------------
+    def ensure_writable(self, page):
+        """COW gate before writing into `page`. An exclusive, unregistered
+        page comes back unchanged (the overwhelmingly common case — a
+        slot's partially-filled tail page). A shared or hash-registered
+        page is swapped for a freshly allocated one: returns
+        (new_page, True) and the caller must copy the device contents
+        old -> new before writing."""
+        if page != NULL_PAGE and self._ref.get(page, 0) == 1 \
+                and page not in self._key_of:
+            return page, False
+        new = self.alloc()
+        self.release(page)
+        if self._metrics is not None:
+            self._metrics.inc("cow_copies")
+        self._gauges()
+        return new, True
+
+    # -- prefix cache -------------------------------------------------------
+    def _chunk(self, tokens, i):
+        ps = self.page_size
+        return tuple(int(t) for t in tokens[i * ps:(i + 1) * ps])
+
+    def match_prefix(self, tokens, commit=True):
+        """Longest chain of cached FULL pages covering a STRICT prefix of
+        `tokens` — capped at (len-1)//page_size pages so at least the final
+        token is always recomputed (its next-token logits are the point of
+        the prefill). With commit=True every hit page is ref'd for the
+        caller (reviving cached pages); commit=False is a side-effect-free
+        peek for admission checks."""
+        max_pages = (len(tokens) - 1) // self.page_size
+        pages, parent = [], -1
+        for i in range(max_pages):
+            p = self._table.get((parent, self._chunk(tokens, i)))
+            if p is None:
+                break
+            pages.append(p)
+            parent = p
+        if commit:
+            for p in pages:
+                self.ref(p)
+        return pages
+
+    def register_prefix(self, tokens, pages):
+        """Register `pages` (the block-table prefix; page i holds tokens
+        [i*ps, (i+1)*ps)) in the hash chain so future prompts sharing this
+        prefix hit them. Only pages FULLY covered by `tokens` may be
+        passed. Pages already on the chain (this prompt's own hits) are
+        walked through, not re-registered."""
+        if len(pages) * self.page_size > len(tokens):
+            raise ValueError("register_prefix: pages not fully covered by "
+                             "the token prefix")
+        parent = -1
+        for i, p in enumerate(pages):
+            key = (parent, self._chunk(tokens, i))
+            existing = self._table.get(key)
+            if existing is not None:
+                parent = existing
+                continue
+            if p in self._key_of:   # already registered under another chain
+                parent = p
+                continue
+            self._table[key] = p
+            self._key_of[p] = key
+            self._parent[p] = parent
+            if parent != -1:
+                self._children.setdefault(parent, set()).add(p)
+            parent = p
+
+    # -- eviction -----------------------------------------------------------
+    def _evict_lru(self):
+        p = next(iter(self._cached))        # least recently used
+        del self._cached[p]
+        self._unregister(p)
+        if self._metrics is not None:
+            self._metrics.inc("page_evictions")
+        return p
+
+    def _unregister(self, page):
+        """Remove a page's hash registration and ORPHAN its descendants:
+        their chain keys embed this page's id, which a recycled page could
+        spoof into serving stale contents. Orphaned cached descendants
+        become plain free pages; orphaned in-use descendants just lose
+        future hits."""
+        key = self._key_of.pop(page, None)
+        if key is None:
+            return
+        self._table.pop(key, None)
+        parent = self._parent.pop(page, None)
+        if parent is not None and parent != -1:
+            self._children.get(parent, set()).discard(page)
+        for child in list(self._children.pop(page, ())):
+            self._unregister(child)
+            if child in self._cached:
+                del self._cached[child]
+                self._free.append(child)

@@ -141,21 +141,27 @@ class Engine:
         self.pad_id = int(pad_id)
         self.metrics = metrics if metrics is not None else Metrics()
 
-        L = lf.stack_leading_dim(params["layers"])
+        self.queue = AdmissionQueue(self.metrics)
+        self.slots = SlotTable(self.max_slots)
+        self._npos = np.zeros(self.max_slots, np.int32)   # next write pos
+        self._last_tok = np.full(self.max_slots, self.pad_id, np.int32)
+        self.step_count = 0
+        self._setup_device_state()
+
+    def _setup_device_state(self):
+        """Allocate the KV cache buffers + compile wrappers (subclass
+        hook: the paged engine replaces the per-slot stripes with a page
+        pool here)."""
+        args = self.args
+        L = lf.stack_leading_dim(self.params["layers"])
         hd = args.hidden_size // args.num_heads
-        cache_dtype = params["embedding"].dtype
+        cache_dtype = self.params["embedding"].dtype
         self._ck = jnp.zeros(
             (L, self.max_slots, args.num_kv_heads, self.max_len, hd),
             cache_dtype)
         self._cv = jnp.zeros_like(self._ck)
         self._cos, self._sin = lf.rope_tables(self.max_len, hd,
                                               args.rope_theta)
-
-        self.queue = AdmissionQueue(self.metrics)
-        self.slots = SlotTable(self.max_slots)
-        self._npos = np.zeros(self.max_slots, np.int32)   # next write pos
-        self._last_tok = np.full(self.max_slots, self.pad_id, np.int32)
-        self.step_count = 0
 
         # donate the KV cache buffers: the engine threads ck/cv through
         # every step and immediately drops the old arrays, so XLA aliases
@@ -193,9 +199,10 @@ class Engine:
     # -- the iteration-level scheduler --------------------------------------
     def step(self):
         """One engine iteration: admit-and-prefill if a request is waiting
-        and a slot is free, else one batched decode step over all active
-        slots, else idle. Returns a small event dict."""
-        if self.queue and self.slots.free_count:
+        and a slot is free (paged engines also require page capacity),
+        else one batched decode step over all active slots, else idle.
+        Returns a small event dict."""
+        if self._can_prefill():
             ev = self._prefill_step()
         elif self.slots.active_slots:
             ev = self._decode_step()
@@ -203,7 +210,14 @@ class Engine:
             ev = {"type": "idle"}
         self.step_count += 1
         self.metrics.observe("slot_occupancy", self.slots.occupancy())
+        self.metrics.set_gauge("active_slots", len(self.slots.active_slots))
         return ev
+
+    def _can_prefill(self):
+        """True when the next queued request can be admitted this step
+        (subclass hook: the paged engine also checks page-pool capacity
+        for the queue head)."""
+        return bool(self.queue and self.slots.free_count)
 
     def run_until_idle(self):
         """Drive step() until every queued/active request completes."""
@@ -256,14 +270,7 @@ class Engine:
         req = self.queue.pop()
         slot = self.slots.admit(req)
         n = int(req.prompt_ids.size)
-        bucket = bucket_for(n, self.min_bucket, self.max_len)
-        padded = np.full((1, bucket), self.pad_id, np.int32)
-        padded[0, :n] = req.prompt_ids
-        with self.metrics.timer("prefill_s"):
-            self._ck, self._cv, first = self._prefill(
-                self.params, jnp.asarray(padded), jnp.int32(n),
-                self._ck, self._cv, jnp.int32(slot), self._cos, self._sin)
-            first = int(first)
+        bucket, first = self._prefill_device(req, slot, n)
         now = time.perf_counter()
         req.first_token_time = now
         # TTFT in wall-clock seconds AND in engine steps: steps are the
@@ -283,13 +290,22 @@ class Engine:
         return {"type": "prefill", "request_id": req.request_id,
                 "slot": slot, "bucket": bucket, "token": first}
 
+    def _prefill_device(self, req, slot, n):
+        """Run the device half of a prefill (subclass hook). Returns
+        (bucket, first_token)."""
+        bucket = bucket_for(n, self.min_bucket, self.max_len)
+        padded = np.full((1, bucket), self.pad_id, np.int32)
+        padded[0, :n] = req.prompt_ids
+        with self.metrics.timer("prefill_s"):
+            self._ck, self._cv, first = self._prefill(
+                self.params, jnp.asarray(padded), jnp.int32(n),
+                self._ck, self._cv, jnp.int32(slot), self._cos, self._sin)
+            first = int(first)
+        return bucket, first
+
     def _decode_step(self):
         active = self.slots.active_slots
-        with self.metrics.timer("decode_step_s"):
-            self._ck, self._cv, nxt = self._decode(
-                self.params, jnp.asarray(self._last_tok), self._ck,
-                self._cv, jnp.asarray(self._npos), self._cos, self._sin)
-            nxt = np.asarray(nxt)
+        nxt = self._decode_device(active)
         emitted = {}
         for slot in active:
             self._npos[slot] += 1
@@ -304,6 +320,15 @@ class Engine:
         self.metrics.inc("tokens_generated", len(active))
         self.metrics.observe("tokens_per_decode_step", len(active))
         return {"type": "decode", "tokens": emitted}
+
+    def _decode_device(self, active):
+        """Run the device half of one batched decode step (subclass
+        hook). Returns the next-token array [S] on host."""
+        with self.metrics.timer("decode_step_s"):
+            self._ck, self._cv, nxt = self._decode(
+                self.params, jnp.asarray(self._last_tok), self._ck,
+                self._cv, jnp.asarray(self._npos), self._cos, self._sin)
+        return np.asarray(nxt)
 
     def _emit(self, req, token):
         req.token_ids.append(token)

@@ -1,0 +1,295 @@
+"""Paged-KV serving engine: block tables + hash-based prefix reuse.
+
+`Engine` (serving/engine.py) reserves a full `max_len` KV stripe per
+slot, so HBM — not compute — caps concurrency, and identical system
+prompts are re-prefilled for every request. `PagedEngine` replaces the
+stripes with the vLLM PagedAttention memory model (Kwon et al.,
+SOSP'23) plus SGLang-style prefix sharing, on the same iteration-level
+scheduler:
+
+  - ONE fixed page pool `[L, num_pages, nkv, page_size, hd]` (heads-major
+    pages — the layout the Pallas paged decode kernel consumes) and a
+    per-slot BLOCK TABLE mapping sequence positions to pages. A request
+    occupies ceil(len/page_size) pages, not max_len — the fragmentation
+    the stripe engine wastes becomes admission headroom;
+  - PREFIX CACHE: full pages of every prefilled prompt are registered in
+    `BlockAllocator`'s exact-match hash chain. A new request walks the
+    chain, REFS the hit pages (shared, refcounted — the bytes exist
+    once), and prefills only the remaining suffix: a shared system
+    prompt is computed once, then every later request starts decoding
+    after a block-table lookup;
+  - PREFILL = gather the hit pages into a contiguous scratch stripe,
+    run the suffix forward at position h (one program per suffix-length
+    bucket — the compile-count discipline of the stripe engine), scatter
+    the freshly computed pages back into the pool;
+  - DECODE = one batched step through `generation.paged_decode_step`:
+    per-row scatter of the new k/v into each slot's tail page, attention
+    gathered through the block tables (per-row page-index prefetch in
+    the Pallas kernel). The host allocates a tail page exactly when a
+    row's position crosses a page boundary, and `ensure_writable` COWs
+    any page that is shared or hash-registered before it is written;
+  - ADMISSION reserves the request's worst-case page count
+    (`scheduler.pages_for` minus prefix hits) so FIFO requests always
+    finish without preemption; when the pool (free + LRU-evictable
+    cached pages) can't cover the queue head, the engine decodes instead
+    and admits later.
+
+Greedy parity with the stripe engine and sequential `generate` is exact:
+pages in table order ARE the contiguous cache (gathering them reproduces
+the stripe bit-for-bit), padded-softmax tails underflow to exact zeros,
+and int8 `quantize_params` trees stream through the same fused
+dequant-matmul dispatch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.models import generation as gen
+from paddle_tpu.models import llama_functional as lf
+from paddle_tpu.serving.block_manager import NULL_PAGE, BlockAllocator
+from paddle_tpu.serving.engine import Engine, Request
+from paddle_tpu.serving.scheduler import bucket_for, pages_for
+
+__all__ = ["PagedEngine"]
+
+
+def _paged_prefill_traced(params, ids, h, last_idx, bt_row, new_pages,
+                          pk, pv, cos, sin, *, args, metrics, page_size,
+                          pages_per_slot):
+    """Prefill a request whose first `h` positions are already cached:
+    gather the slot's pages into a contiguous scratch stripe, forward the
+    SUFFIX tokens at position h, scatter the freshly written pages back.
+
+    ids: [1, sb] suffix right-padded to a length bucket; h: traced token
+    count covered by prefix hits (a page multiple); last_idx: index of the
+    prompt's true last token WITHIN the suffix block (n - 1 - h);
+    bt_row/new_pages: [P] page indices (unused entries -> null page 0).
+    One XLA program per suffix bucket — h, last_idx and the page vectors
+    are traced operands, so hit depth never recompiles."""
+    metrics.inc("prefill_compiles")
+    L, nkv, hd = pk.shape[0], pk.shape[2], pk.shape[4]
+    ps, P = page_size, pages_per_slot
+    sb = ids.shape[1]
+    dtype = pk.dtype
+
+    # gather the block-table row into contiguous [L, 1, nkv, P*ps, hd]
+    # (hit pages carry real prefix K/V; later entries are garbage that the
+    # suffix writes + position mask keep unread), then pad by the suffix
+    # bucket so the write at [h, h+sb) can never clamp
+    g_k = jnp.swapaxes(pk[:, bt_row], 1, 2).reshape(L, 1, nkv, P * ps, hd)
+    g_v = jnp.swapaxes(pv[:, bt_row], 1, 2).reshape(L, 1, nkv, P * ps, hd)
+    pad = jnp.zeros((L, 1, nkv, sb, hd), dtype)
+    temp_k = jnp.concatenate([g_k, pad], axis=3)
+    temp_v = jnp.concatenate([g_v, pad], axis=3)
+
+    logits, temp_k, temp_v = gen._forward_cached(
+        params, ids, temp_k, temp_v, h, cos, sin, args, last_idx=last_idx)
+    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+
+    # scatter the newly computed pages (suffix positions [h + i*ps, ...))
+    # into the pool; unused entries land on the null page
+    def chunk(t, i):
+        return jax.lax.dynamic_slice_in_dim(t, h + i * ps, ps, axis=3)
+
+    new_k = jnp.concatenate([chunk(temp_k, i) for i in range(P)], axis=1)
+    new_v = jnp.concatenate([chunk(temp_v, i) for i in range(P)], axis=1)
+    pk = pk.at[:, new_pages].set(new_k)   # [L, P, nkv, ps, hd]
+    pv = pv.at[:, new_pages].set(new_v)
+    return pk, pv, first
+
+
+def _paged_decode_traced(params, tokens, pk, pv, bt, pos, cos, sin, *,
+                         args, metrics, page_size):
+    metrics.inc("decode_compiles")
+    logits, pk, pv = gen._paged_forward_decode(
+        params, tokens[:, None], pk, pv, bt, pos, cos, sin, args, page_size)
+    return pk, pv, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _copy_page_traced(pk, pv, src, dst):
+    """Device half of copy-on-write: clone one page's K/V across layers."""
+    pk = jax.lax.dynamic_update_slice_in_dim(
+        pk, jax.lax.dynamic_slice_in_dim(pk, src, 1, axis=1), dst, axis=1)
+    pv = jax.lax.dynamic_update_slice_in_dim(
+        pv, jax.lax.dynamic_slice_in_dim(pv, src, 1, axis=1), dst, axis=1)
+    return pk, pv
+
+
+class PagedEngine(Engine):
+    """Continuous-batching engine over a paged KV cache with prefix reuse.
+
+    page_size: tokens per KV page. On TPU keep it a multiple of 16 (bf16
+               sublane tile) with head_dim a multiple of 128 so the Pallas
+               paged decode kernel stays eligible; it is also the prefix-
+               cache granularity (only full pages are shared).
+    num_pages: pool size INCLUDING the reserved null page 0. Defaults to
+               max_slots * (max_len/page_size) + 1 — the stripe engine's
+               capacity; set it lower to oversubscribe slots against the
+               real (sub-max_len, prefix-shared) footprint, which is the
+               entire point.
+    max_len:   per-REQUEST cap (block tables hold max_len/page_size
+               entries); no longer a per-slot HBM reservation.
+    """
+
+    def __init__(self, params, args, *, max_slots=4, max_len=256,
+                 page_size=16, num_pages=None, min_bucket=16, pad_id=0,
+                 metrics=None):
+        if max_len % page_size != 0:
+            raise ValueError(
+                f"max_len={max_len} must be a multiple of "
+                f"page_size={page_size}")
+        self.page_size = int(page_size)
+        self.pages_per_slot = int(max_len) // self.page_size
+        self.num_pages = (int(num_pages) if num_pages is not None
+                          else int(max_slots) * self.pages_per_slot + 1)
+        super().__init__(params, args, max_slots=max_slots, max_len=max_len,
+                         min_bucket=min_bucket, pad_id=pad_id,
+                         metrics=metrics)
+
+    def _setup_device_state(self):
+        args = self.args
+        L = lf.stack_leading_dim(self.params["layers"])
+        hd = args.hidden_size // args.num_heads
+        dtype = self.params["embedding"].dtype
+        self._pk = jnp.zeros(
+            (L, self.num_pages, args.num_kv_heads, self.page_size, hd),
+            dtype)
+        self._pv = jnp.zeros_like(self._pk)
+        # 2*max_len: suffix prefills write at [h, h+bucket), which can
+        # overshoot max_len before masking trims it
+        self._cos, self._sin = lf.rope_tables(2 * self.max_len, hd,
+                                              args.rope_theta)
+
+        self._alloc = BlockAllocator(self.num_pages, self.page_size,
+                                     metrics=self.metrics)
+        self._bt = [[] for _ in range(self.max_slots)]   # host block tables
+        self._resv = {}            # slot -> pages still reserved for decode
+        self._reserved_total = 0
+
+        donate = jax.default_backend() == "tpu"
+        self._prefill = jax.jit(
+            functools.partial(_paged_prefill_traced, args=args,
+                              metrics=self.metrics,
+                              page_size=self.page_size,
+                              pages_per_slot=self.pages_per_slot),
+            donate_argnums=(6, 7) if donate else ())
+        self._decode = jax.jit(
+            functools.partial(_paged_decode_traced, args=args,
+                              metrics=self.metrics,
+                              page_size=self.page_size),
+            donate_argnums=(2, 3) if donate else ())
+        self._copy_page = jax.jit(
+            _copy_page_traced, donate_argnums=(0, 1) if donate else ())
+
+    # -- admission ----------------------------------------------------------
+    def submit(self, req):
+        if not isinstance(req, Request):
+            req = Request(req)
+        need = pages_for(req.prompt_ids.size, req.max_new_tokens,
+                         self.page_size)
+        if need > self._alloc.capacity:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self._alloc.capacity} (num_pages={self.num_pages}, "
+                f"page_size={self.page_size})")
+        return super().submit(req)
+
+    def _can_prefill(self):
+        if not (self.queue and self.slots.free_count):
+            return False
+        req = self.queue.peek()
+        hits = self._alloc.match_prefix(req.prompt_ids, commit=False)
+        # reviving a cached (refcount-0) hit consumes availability just
+        # like a fresh alloc; an actively shared hit is free
+        revive = sum(1 for p in hits if self._alloc.refcount(p) == 0)
+        need = (pages_for(req.prompt_ids.size, req.max_new_tokens,
+                          self.page_size) - len(hits) + revive)
+        return need <= self._alloc.available - self._reserved_total
+
+    # -- prefill ------------------------------------------------------------
+    def _prefill_device(self, req, slot, n):
+        ps, P = self.page_size, self.pages_per_slot
+        hits = self._alloc.match_prefix(req.prompt_ids)   # refs hit pages
+        h = len(hits) * ps
+        n_now = -(-n // ps) - len(hits)                   # pages to write
+        new_pages = [self._alloc.alloc() for _ in range(n_now)]
+        pages = hits + new_pages
+        resv = pages_for(n, req.max_new_tokens, ps) - len(pages)
+        self._resv[slot] = resv
+        self._reserved_total += resv
+        self._bt[slot] = pages
+
+        bt_row = np.zeros(P, np.int32)
+        bt_row[:len(pages)] = pages
+        new_vec = np.full(P, NULL_PAGE, np.int32)
+        new_vec[:n_now] = new_pages
+        sb = bucket_for(n - h, self.min_bucket, self.max_len)
+        padded = np.full((1, sb), self.pad_id, np.int32)
+        padded[0, :n - h] = req.prompt_ids[h:]
+        with self.metrics.timer("prefill_s"):
+            self._pk, self._pv, first = self._prefill(
+                self.params, jnp.asarray(padded), jnp.int32(h),
+                jnp.int32(n - 1 - h), jnp.asarray(bt_row),
+                jnp.asarray(new_vec), self._pk, self._pv,
+                self._cos, self._sin)
+            first = int(first)
+        # make this prompt's full pages hittable for future requests
+        self._alloc.register_prefix(req.prompt_ids, pages[:n // ps])
+        self.metrics.inc("prompt_tokens", n)
+        self.metrics.inc("prefix_tokens_hit", h)
+        self.metrics.inc("prefix_pages_hit", len(hits))
+        self.metrics.inc("prefix_pages_queried", (n - 1) // ps)
+        return sb, first
+
+    # -- decode -------------------------------------------------------------
+    def _decode_device(self, active):
+        ps, P = self.page_size, self.pages_per_slot
+        for slot in active:
+            pi = int(self._npos[slot]) // ps
+            pages = self._bt[slot]
+            if pi == len(pages):
+                # crossing a page boundary: draw the tail page from this
+                # slot's admission-time reservation
+                pages.append(self._alloc.alloc())
+                self._resv[slot] -= 1
+                self._reserved_total -= 1
+            else:
+                old = pages[pi]
+                page, copied = self._alloc.ensure_writable(old)
+                if copied:
+                    self._pk, self._pv = self._copy_page(
+                        self._pk, self._pv, jnp.int32(old), jnp.int32(page))
+                    pages[pi] = page
+        bt = np.full((self.max_slots, P), NULL_PAGE, np.int32)
+        for slot in active:
+            bt[slot, :len(self._bt[slot])] = self._bt[slot]
+        with self.metrics.timer("decode_step_s"):
+            self._pk, self._pv, nxt = self._decode(
+                self.params, jnp.asarray(self._last_tok), self._pk,
+                self._pv, jnp.asarray(bt), jnp.asarray(self._npos),
+                self._cos, self._sin)
+        return np.asarray(nxt)
+
+    # -- lifecycle ----------------------------------------------------------
+    def _retire(self, slot):
+        for p in self._bt[slot]:
+            self._alloc.release(p)
+        self._bt[slot] = []
+        self._reserved_total -= self._resv.pop(slot, 0)
+        super()._retire(slot)
+
+    def reset(self):
+        """Forget all requests, block tables, AND the prefix cache (cold
+        cache — a warm timed run after reset would be all hits and lie);
+        compiled programs and compile counters survive."""
+        super().reset()
+        self._alloc = BlockAllocator(self.num_pages, self.page_size,
+                                     metrics=self.metrics)
+        self._bt = [[] for _ in range(self.max_slots)]
+        self._resv = {}
+        self._reserved_total = 0

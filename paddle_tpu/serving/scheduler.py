@@ -21,7 +21,7 @@ from __future__ import annotations
 
 from collections import deque
 
-__all__ = ["bucket_for", "AdmissionQueue", "SlotTable"]
+__all__ = ["bucket_for", "pages_for", "AdmissionQueue", "SlotTable"]
 
 
 def bucket_for(n, min_bucket=16, max_bucket=None):
@@ -38,6 +38,17 @@ def bucket_for(n, min_bucket=16, max_bucket=None):
                 f"prompt length {n} exceeds the largest bucket {max_bucket}")
         b = min(b, int(max_bucket))
     return b
+
+
+def pages_for(prompt_len, max_new_tokens, page_size):
+    """Worst-case page count for one request in the paged KV cache: KV is
+    written for positions [0, prompt_len + max_new_tokens - 2] — the last
+    emitted token is returned to the caller but its k/v is never written
+    back (there is no further decode step to read it). This is what paged
+    admission reserves up front, so a request admitted under FIFO can
+    always finish without preemption."""
+    last = int(prompt_len) + max(int(max_new_tokens), 1) - 2
+    return max(last, 0) // int(page_size) + 1
 
 
 class AdmissionQueue:
@@ -60,6 +71,11 @@ class AdmissionQueue:
         req = self._q.popleft()
         self._gauge()
         return req
+
+    def peek(self):
+        """Head of the queue without removing it (paged admission checks
+        the head's page demand before committing a prefill step)."""
+        return self._q[0]
 
     def __len__(self):
         return len(self._q)

@@ -26,18 +26,33 @@ __all__ = ["make_trace", "trace_stats"]
 def make_trace(seed=0, n_requests=24, mean_interarrival_steps=2.0,
                prompt_len_choices=(3, 5, 7, 9, 12, 17, 23, 31),
                new_tokens_choices=(4, 8, 12), vocab_size=128, pad_id=0,
-               eos_token_id=None):
+               eos_token_id=None, shared_prefix_len=0,
+               shared_prefix_ratio=1.0):
     """Mixed-length request trace: each entry is
     {'request_id', 'arrival_step', 'prompt' (int32 [len], never pad_id),
-     'max_new_tokens'[, 'eos_token_id']} — the dict shape
-    `serving.Engine.replay` consumes. Deterministic for a given seed."""
+     'max_new_tokens', 'shared_prefix'[, 'eos_token_id']} — the dict shape
+    `serving.Engine.replay` consumes. Deterministic for a given seed.
+
+    shared_prefix_len > 0 models SYSTEM-PROMPT REUSE: one seeded prefix of
+    that length is generated per trace and prepended to a
+    `shared_prefix_ratio` fraction of requests (prompt_len_choices then
+    size the UNIQUE suffix). This is the workload paged prefix caching is
+    built for — the prefix should prefill once and hit thereafter."""
     rng = np.random.default_rng(seed)
     gaps = rng.exponential(mean_interarrival_steps, n_requests)
     arrivals = np.floor(np.cumsum(gaps)).astype(int)
+    prefix = None
+    if shared_prefix_len:
+        prefix = rng.integers(1, vocab_size,
+                              size=int(shared_prefix_len)).astype(np.int32)
     trace = []
     for i in range(n_requests):
         plen = int(rng.choice(prompt_len_choices))
         prompt = rng.integers(1, vocab_size, size=plen).astype(np.int32)
+        shared = (prefix is not None
+                  and float(rng.random()) < shared_prefix_ratio)
+        if shared:
+            prompt = np.concatenate([prefix, prompt])
         if pad_id != 0:
             prompt[prompt == pad_id] = (pad_id + 1) % vocab_size or 1
         entry = {
@@ -45,6 +60,7 @@ def make_trace(seed=0, n_requests=24, mean_interarrival_steps=2.0,
             "arrival_step": int(arrivals[i]),
             "prompt": prompt,
             "max_new_tokens": int(rng.choice(new_tokens_choices)),
+            "shared_prefix": shared,
         }
         if eos_token_id is not None:
             entry["eos_token_id"] = int(eos_token_id)
@@ -61,6 +77,8 @@ def trace_stats(trace):
         "prompt_len_max": max(plens),
         "distinct_prompt_lens": len(set(plens)),
         "last_arrival_step": max(t["arrival_step"] for t in trace),
+        "shared_prefix_requests": sum(1 for t in trace
+                                      if t.get("shared_prefix")),
     }
 
 
@@ -72,9 +90,13 @@ if __name__ == "__main__":
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--n", type=int, default=24)
     ap.add_argument("--mean-gap", type=float, default=2.0)
+    ap.add_argument("--shared-prefix-len", type=int, default=0)
+    ap.add_argument("--shared-prefix-ratio", type=float, default=1.0)
     args = ap.parse_args()
     trace = make_trace(seed=args.seed, n_requests=args.n,
-                       mean_interarrival_steps=args.mean_gap)
+                       mean_interarrival_steps=args.mean_gap,
+                       shared_prefix_len=args.shared_prefix_len,
+                       shared_prefix_ratio=args.shared_prefix_ratio)
     print(json.dumps({
         "stats": trace_stats(trace),
         "requests": [{"request_id": t["request_id"],
