@@ -138,7 +138,6 @@ class BlockAllocator:
         del self._ref[page]
         if page in self._key_of:
             self._cached[page] = True       # most-recently-used position
-            self._cached.move_to_end(page)
         else:
             self._free.append(page)
         self._gauges()

@@ -36,6 +36,12 @@ streaming callbacks, wall-clock metrics) runs in Python between steps;
 the two traced programs (per-bucket prefill, one decode) contain no
 wall-clock reads and re-compile only when a NEW bucket shape arrives —
 compile counts are metered at trace time (`serving/metrics.py`).
+
+`serving/paged_engine.PagedEngine` subclasses this scheduler loop but
+swaps the per-slot stripes for a paged KV cache (page pool + block
+tables + hash-based prefix reuse) — far more concurrent requests per
+byte of KV HBM; the stripe engine remains the simple baseline and the
+equal-HBM comparison leg in `bench.py --serving`.
 """
 
 from __future__ import annotations

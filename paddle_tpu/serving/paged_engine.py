@@ -22,7 +22,8 @@ scheduler:
     run the suffix forward at position h (one program per suffix-length
     bucket — the compile-count discipline of the stripe engine), scatter
     the freshly computed pages back into the pool;
-  - DECODE = one batched step through `generation.paged_decode_step`:
+  - DECODE = one batched paged step (`generation._paged_forward_decode`,
+    the traced body behind the public `generation.paged_decode_step`):
     per-row scatter of the new k/v into each slot's tail page, attention
     gathered through the block tables (per-row page-index prefetch in
     the Pallas kernel). The host allocates a tail page exactly when a

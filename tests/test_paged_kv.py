@@ -329,6 +329,47 @@ class TestPagedEngineParity:
             np.testing.assert_array_equal(np.asarray(r.token_ids), s)
 
 
+class TestPagedDecodeStep:
+    def test_public_api_matches_stripe_decode_step(self, params):
+        """generation.paged_decode_step (the public per-step API) must
+        agree with the contiguous decode_step when the block tables lay
+        the same KV out page-by-page."""
+        from paddle_tpu.models.generation import (decode_step,
+                                                  paged_decode_step,
+                                                  prefill)
+
+        ids = np.array([[5, 11, 7, 2], [9, 3, 1, 8]], np.int32)
+        logits, ck, cv = prefill(params, ARGS, ids, max_len=16)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        pos = jnp.asarray([4, 4], jnp.int32)
+        l_ref, ck_ref, cv_ref = decode_step(params, ARGS, tok, ck, cv,
+                                            pos, 16)
+        # lay the stripe caches out as pages: row r's page i = slot cache
+        # [r, :, i*ps:(i+1)*ps]; pool axis order [L, pages, nkv, ps, hd]
+        ps, P, b = 8, 2, 2
+        bt = np.array([[1, 2], [3, 4]], np.int32)
+        pool_shape = (ARGS.num_layers, 1 + b * P, ARGS.num_kv_heads, ps,
+                      ARGS.hidden_size // ARGS.num_heads)
+        pk = np.zeros(pool_shape, np.float32)
+        pv = np.zeros(pool_shape, np.float32)
+        for r in range(b):
+            for i in range(P):
+                pk[:, bt[r, i]] = np.asarray(ck)[:, r, :, i * ps:(i + 1) * ps]
+                pv[:, bt[r, i]] = np.asarray(cv)[:, r, :, i * ps:(i + 1) * ps]
+        l_paged, npk, npv = paged_decode_step(
+            params, ARGS, tok, jnp.asarray(pk), jnp.asarray(pv),
+            jnp.asarray(bt), pos, page_size=ps)
+        np.testing.assert_array_equal(np.asarray(l_ref), np.asarray(l_paged))
+        # the new k/v landed in each row's tail page at offset pos % ps
+        for r in range(b):
+            np.testing.assert_array_equal(
+                np.asarray(npk)[:, bt[r, 0], :, 4],
+                np.asarray(ck_ref)[:, r, :, 4])
+            np.testing.assert_array_equal(
+                np.asarray(npv)[:, bt[r, 0], :, 4],
+                np.asarray(cv_ref)[:, r, :, 4])
+
+
 class TestPagedScheduling:
     def test_eos_retires_and_slot_readmits(self, params, engine):
         prompts = _prompts([3, 5, 7], seed=11)
