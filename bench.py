@@ -329,7 +329,7 @@ def _bench_int8_decode(batches=(1, 4, 8), prompt=128, new_tokens=384,
     return out
 
 
-def _bench_serving(seed=0):
+def _bench_serving(seed=0, only=None):
     """Continuous batching vs sequential generate on the SAME deterministic
     mixed-length arrival trace (tools/serving_trace.py): tokens/sec,
     time-to-first-token, slot occupancy, and compile counts. Sequential
@@ -339,7 +339,12 @@ def _bench_serving(seed=0):
     granularity, so decode steps are shared across slots. Both legs are
     warmed first (all shapes compiled), so the timed section measures
     steady-state serving, and the engine's compile counters prove the
-    bucket policy bounds program count."""
+    bucket policy bounds program count.
+
+    only='chunked_prefill' / 'speculative' (CLI: `--serving
+    --chunked-prefill` / `--serving --speculative`) runs just that leg —
+    the record keeps the same per-leg shape, so --telemetry-out artifacts
+    stay diffable against full --serving runs."""
     import signal
 
     def _stuck(signum, frame):
@@ -386,6 +391,18 @@ def _bench_serving(seed=0):
                            new_tokens_choices=(16,),
                            vocab_size=args.vocab_size)
 
+    if only is not None:
+        out = {"backend": backend}
+        if only == "chunked_prefill":
+            out["chunked_prefill"] = _bench_chunked_prefill(
+                params, args, backend, seed)
+        elif only == "speculative":
+            out["speculative"] = _bench_speculative(backend, seed)
+        else:
+            raise ValueError(f"unknown serving leg {only!r}")
+        print("BENCH_SERVING " + json.dumps(out))
+        return out
+
     # -- sequential generate: one request at a time, arrival order ---------
     def run_sequential():
         toks = 0
@@ -428,13 +445,211 @@ def _bench_serving(seed=0):
         "ttft_s_p50": round(ttft["p50"], 4),
         "ttft_s_p95": round(ttft["p95"], 4),
         "ttft_s_p99": round(ttft["p99"], 4),
+        # prefill_done != ttft under chunked prefill (first EMITTED token
+        # vs prompt-fully-cached) — both kept so telemetry stays diffable
+        "prefill_done_s_p99": round(
+            m["observations"]["prefill_done_s"]["p99"], 4),
         "slot_occupancy_mean": round(occ["sum"] / occ["count"], 3),
         "prefill_compiles": m["counters"]["prefill_compiles"],
         "decode_compiles": m["counters"]["decode_compiles"],
     }
     out["equal_hbm"] = _bench_paged_vs_stripe(params, args, backend, seed)
+    out["chunked_prefill"] = _bench_chunked_prefill(params, args, backend,
+                                                    seed)
+    out["speculative"] = _bench_speculative(backend, seed)
     print("BENCH_SERVING " + json.dumps(out))
     return out
+
+
+def _bench_chunked_prefill(params, args, backend, seed):
+    """Chunked vs monolithic prefill on a mixed trace (a long-prompt
+    burst dropped into a short-prompt stream, tools/serving_trace.py
+    make_mixed_trace): the acceptance metric is the SHORT requests' TTFT
+    p99 — shorts queued behind a monolithic long prefill wait out its
+    whole wall time, while the chunked engine admits them between chunks
+    (and the anti-convoy bypass admits them past queued longs). Bar:
+    chunked short-TTFT p99 <= 0.5x monolithic (ISSUE 14). On CPU the
+    leg builds its own heavier model: the prefill stall must be compute,
+    not dispatch overhead, for the monolithic number to mean anything."""
+    import jax
+
+    from paddle_tpu.models import llama_functional as lf
+    from paddle_tpu.serving import PagedEngine
+    from tools.serving_trace import make_mixed_trace, trace_stats
+
+    if backend == "tpu":
+        slots, max_len, ps, chunk, min_bucket = 16, 2048, 64, 256, 64
+        trace = make_mixed_trace(seed=seed, n_short=32,
+                                 short_len_choices=(24, 40, 57, 96),
+                                 n_long=2, long_len=1536,
+                                 mean_interarrival_steps=2.5,
+                                 new_tokens_choices=(32,),
+                                 long_new_tokens=32,
+                                 vocab_size=args.vocab_size)
+    else:
+        args = lf.LlamaArgs(vocab_size=512, hidden_size=256,
+                            intermediate_size=704, num_layers=4,
+                            num_heads=4, num_kv_heads=2, rope_theta=1e4,
+                            rms_eps=1e-6, use_flash=False)
+        params = lf.init_params(args, jax.random.key(0))
+        slots, max_len, ps, chunk, min_bucket = 16, 1024, 32, 128, 8
+        trace = make_mixed_trace(seed=seed, n_short=16,
+                                 short_len_choices=(6, 9, 14, 21),
+                                 n_long=2, long_len=768,
+                                 mean_interarrival_steps=2.5,
+                                 new_tokens_choices=(4,),
+                                 long_new_tokens=4,
+                                 vocab_size=args.vocab_size)
+    long_ids = {t["request_id"] for t in trace if t["long"]}
+
+    def run(prefill_chunk):
+        eng = PagedEngine(params, args, max_slots=slots, max_len=max_len,
+                          page_size=ps, min_bucket=min_bucket,
+                          prefill_chunk=prefill_chunk)
+        eng.replay(trace)   # warm: compile every program
+        eng.reset()
+        t0 = time.perf_counter()
+        reqs = eng.replay(trace)
+        dt = time.perf_counter() - t0
+        short_ttft = sorted(r.ttft_s for r in reqs
+                            if r.request_id not in long_ids)
+        long_ttft = sorted(r.ttft_s for r in reqs
+                           if r.request_id in long_ids)
+        m = eng.metrics.summary()
+        c = m["counters"]
+
+        def pq(xs, q):
+            return xs[min(int(q * len(xs)), len(xs) - 1)]
+
+        return {
+            "tokens_per_sec": round(
+                sum(len(r.token_ids) for r in reqs) / dt, 1),
+            "short_ttft_s_p50": round(pq(short_ttft, 0.5), 4),
+            "short_ttft_s_p95": round(pq(short_ttft, 0.95), 4),
+            "short_ttft_s_p99": round(pq(short_ttft, 0.99), 4),
+            "long_ttft_s_max": round(long_ttft[-1], 4),
+            "prefill_chunks": c.get("prefill_chunks", 0),
+            "chunked_prefills": c.get("chunked_prefills", 0),
+            # scheduler steps a prefill spent while decodable slots
+            # waited — the stall metric chunking exists to flatten
+            "prefill_stall_steps": int(
+                m["gauges"].get("prefill_stall_steps", {}).get("max", 0)),
+        }
+
+    mono = run(None)
+    chunked = run(chunk)
+    return {
+        "trace": trace_stats(trace),
+        "prefill_chunk": chunk,
+        "monolithic": mono,
+        "chunked": chunked,
+        # the acceptance ratio: how much of the long-prefill stall the
+        # interleave removed from queued short requests
+        "short_ttft_p99_ratio": round(
+            chunked["short_ttft_s_p99"]
+            / max(mono["short_ttft_s_p99"], 1e-9), 3),
+    }
+
+
+def _bench_speculative(backend, seed):
+    """Speculative vs plain greedy decoding on the paged engine. The rig
+    builds its own target: random-init weights admit no LEARNED draft
+    (any truncation's argmax is noise), so the target's later layers are
+    damped to a small residual contribution and the draft is the 1-layer
+    truncation (`generation.draft_from_params`) — a synthetic stand-in
+    for the trained-draft agreement (~0.7 here) speculative decoding
+    presupposes. Output parity with plain greedy is asserted, so the
+    speedup is never bought with wrong tokens. Bar: >= 1.5x tokens/sec
+    (ISSUE 14)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama_functional as lf
+    from paddle_tpu.models.generation import draft_from_params
+    from paddle_tpu.serving import PagedEngine
+    from tools.serving_trace import make_trace, trace_stats
+
+    if backend == "tpu":
+        sargs = lf.LlamaArgs(vocab_size=32000, hidden_size=2048,
+                             intermediate_size=5504, num_layers=16,
+                             num_heads=16, num_kv_heads=16, rope_theta=1e4,
+                             rms_eps=1e-6, use_flash=False)
+        draft_layers, spec_tokens = 4, 4
+        slots, max_len, ps, min_bucket = 8, 1024, 64, 64
+        dtype = jnp.bfloat16
+        trace = make_trace(seed=seed, n_requests=24,
+                           mean_interarrival_steps=0.5,
+                           prompt_len_choices=(24, 40, 57, 96),
+                           new_tokens_choices=(128,), vocab_size=32000)
+    else:
+        sargs = lf.LlamaArgs(vocab_size=512, hidden_size=128,
+                             intermediate_size=352, num_layers=4,
+                             num_heads=4, num_kv_heads=2, rope_theta=1e4,
+                             rms_eps=1e-6, use_flash=False)
+        draft_layers, spec_tokens = 1, 6
+        # low concurrency: the regime speculation targets — decode wall
+        # time per token is dominated by per-step overhead/weight
+        # streaming, not by batched FLOPs (at high occupancy the batch
+        # already amortizes those and speculation adds little)
+        slots, max_len, ps, min_bucket = 2, 80, 8, 8
+        dtype = jnp.float32
+        trace = make_trace(seed=seed, n_requests=8,
+                           mean_interarrival_steps=4.0,
+                           prompt_len_choices=(5, 9, 14, 17),
+                           new_tokens_choices=(48,), vocab_size=512)
+    sparams = lf.init_params(sargs, jax.random.key(0), dtype)
+    damp = jnp.asarray([1.0] * draft_layers
+                       + [0.02] * (sargs.num_layers - draft_layers),
+                       jnp.float32).reshape(-1, 1, 1).astype(dtype)
+    for k in ("wo", "w_down"):
+        sparams["layers"][k] = sparams["layers"][k] * damp
+    draft_params, draft_args = draft_from_params(sparams, sargs,
+                                                 draft_layers)
+
+    def run(**kw):
+        eng = PagedEngine(sparams, sargs, max_slots=slots, max_len=max_len,
+                          page_size=ps, min_bucket=min_bucket, **kw)
+        eng.replay(trace)
+        eng.reset()
+        t0 = time.perf_counter()
+        reqs = eng.replay(trace)
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.token_ids) for r in reqs)
+        m = eng.metrics.summary()
+        return ({"tokens_per_sec": round(toks / dt, 1)}, m,
+                [list(r.token_ids) for r in reqs])
+
+    greedy, _, out_g = run()
+    spec, m, out_s = run(draft_params=draft_params, draft_args=draft_args,
+                         spec_tokens=spec_tokens)
+    parity = out_g == out_s
+    # a speedup bought with wrong tokens must fail the bench, not merely
+    # record greedy_parity: false in the artifact
+    assert parity, "speculative decoding broke greedy parity"
+    c = m["counters"]
+    acc = m["observations"].get("spec_acceptance_rate") or {}
+    spec.update({
+        "draft_layers": draft_layers,
+        "spec_tokens": spec_tokens,
+        "acceptance_rate": round(
+            c.get("draft_tokens_accepted", 0)
+            / max(c.get("draft_tokens_proposed", 1), 1), 3),
+        # the per-round acceptance-rate histogram (registry quantiles)
+        "acceptance_rate_p50": round(acc.get("p50", 0.0), 3),
+        "acceptance_rate_p95": round(acc.get("p95", 0.0), 3),
+        "draft_tokens_proposed": c.get("draft_tokens_proposed", 0),
+        "draft_tokens_accepted": c.get("draft_tokens_accepted", 0),
+        "spec_rounds": c.get("spec_rounds", 0),
+        "spec_pages_rewound": c.get("spec_pages_rewound", 0),
+    })
+    return {
+        "trace": trace_stats(trace),
+        "greedy": greedy,
+        "speculative": spec,
+        "greedy_parity": parity,
+        "speedup": round(spec["tokens_per_sec"]
+                         / max(greedy["tokens_per_sec"], 1e-9), 3),
+    }
 
 
 def _bench_paged_vs_stripe(params, args, backend, seed):
@@ -762,6 +977,10 @@ if __name__ == "__main__":
         _rec = _bench_int8_decode()
     elif _argv == ["--serving"]:
         _rec = _bench_serving()
+    elif _argv in (["--serving", "--chunked-prefill"], ["--chunked-prefill"]):
+        _rec = _bench_serving(only="chunked_prefill")
+    elif _argv in (["--serving", "--speculative"], ["--speculative"]):
+        _rec = _bench_serving(only="speculative")
     else:
         sys.exit(main(telemetry_out=_tele))
     if _tele:  # subcommand modes write the same artifact shape as main()

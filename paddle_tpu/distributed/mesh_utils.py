@@ -24,7 +24,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-__all__ = ["create_hybrid_mesh", "slice_count", "shard_map_compat"]
+__all__ = ["create_hybrid_mesh", "single_axis_mesh", "slice_count",
+           "shard_map_compat"]
 
 
 _legacy_rules_registered = False
@@ -126,6 +127,18 @@ def slice_count(devices=None):
     or CPU platforms, whose devices carry no slice_index)."""
     devices = list(devices if devices is not None else jax.devices())
     return len({getattr(d, "slice_index", 0) for d in devices})
+
+
+def single_axis_mesh(axis, degree, devices=None):
+    """A one-axis Mesh over the first `degree` devices — the
+    tensor-parallel serving mesh (`serving.PagedEngine(mesh=...)`), and
+    the degenerate case of `create_hybrid_mesh` that doesn't require the
+    axes product to cover every device on the host."""
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < degree:
+        raise ValueError(
+            f"axis {axis!r} needs {degree} devices, got {len(devices)}")
+    return create_hybrid_mesh({axis: int(degree)}, devices[:int(degree)])
 
 
 def create_hybrid_mesh(axes, devices=None, dcn_axis=None):

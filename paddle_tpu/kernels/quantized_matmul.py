@@ -46,8 +46,9 @@ from jax.experimental.pallas import tpu as pltpu
 from paddle_tpu.kernels.flash_attention import _pick_block
 
 __all__ = ["fused_dequant_matmul", "weight_only_matmul", "decode_attention",
-           "paged_decode_attention", "paged_gather", "fused_dispatch",
-           "fused_enabled", "matmul_supported", "decode_supported",
+           "window_decode_attention", "paged_decode_attention",
+           "paged_gather", "fused_dispatch", "fused_enabled",
+           "matmul_supported", "decode_supported", "window_supported",
            "paged_decode_supported", "quantize_absmax"]
 
 _NEG_INF = -1e30
@@ -342,6 +343,166 @@ def _decode_attention_xla(q, cache_k, cache_v, pos, sm_scale):
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(cache_v.dtype), cache_v)
     return jnp.swapaxes(attn, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# window attention (a short run of queries at a traced offset vs the cache)
+# ---------------------------------------------------------------------------
+
+
+def _window_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, *, block_k,
+                   sm_scale, gsize, window, kv_blocks):
+    # blocks: q/o [1, 1, s*g, d] — the window's s queries for the g query
+    # heads sharing this kv head, flattened query-major; k/v
+    # [1, 1, max_len, d]; pos is scalar-prefetched PER ROW [b]. Query i
+    # of the window sits at sequence position pos + i: the chunk-offset
+    # prefill / speculative-verify masking rule (key <= pos + i), with
+    # the online max/sum stopping at the LAST query's watermark instead
+    # of re-softmaxing the padded cache length.
+    pos = pos_ref[pl.program_id(0)]
+    q = q_ref[0, 0]  # [s*g, d]
+    sg, d = q.shape
+    qidx = jax.lax.broadcasted_iota(jnp.int32, (sg, 1), 0) // gsize
+
+    m0 = jnp.full((sg, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((sg, 1), jnp.float32)
+    acc0 = jnp.zeros((sg, d), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [sg, bk]
+        cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32,
+                                                      (sg, block_k), 1)
+        s = jnp.where(cols <= pos + qidx, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    # stop at the last query's watermark pos + window - 1, clamped to the
+    # cache: a tail speculation window can overhang max_len (writes past
+    # the reservation go to the null page, but the watermark still lands
+    # beyond the cache) and an unclamped bound would read k/v out of range
+    n_kb = jnp.minimum((pos + window - 1 + block_k) // block_k, kv_blocks)
+    acc, m, l = jax.lax.fori_loop(0, n_kb, body, (acc0, m0, l0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+# windows larger than this fall back to the masked-einsum composition:
+# the kernel streams the whole [s*g, block_k] score tile through VMEM per
+# step, which is only a win for the SHORT windows speculation and
+# chunk-tail prefills produce (a full-length prefill wants real flash
+# query tiling instead)
+_WINDOW_MAX_ROWS = 64
+
+
+def window_supported(q_shape, cache_shape, itemsize=2):
+    """True when the Pallas window kernel can take q [b, s, nh, hd]
+    (query i of row r at position pos[r] + i) against cache
+    [b, nkv, max_len, hd]: a SHORT window (s*g <= 64 flattened rows —
+    the speculative-verify / chunk-offset regime), 128-aligned cache
+    length, query heads a multiple of kv heads, working set in VMEM."""
+    if len(q_shape) != 4 or q_shape[1] < 1:
+        return False
+    b, s, nh, hd = q_shape
+    nkv, max_len = cache_shape[1], cache_shape[2]
+    if max_len % 128 != 0 or nkv <= 0 or nh % nkv != 0:
+        return False
+    if s * (nh // nkv) > _WINDOW_MAX_ROWS:
+        return False
+    per_step = 2 * 2 * max_len * hd * itemsize
+    return per_step <= _VMEM_BUDGET_BYTES
+
+
+def _window_attention_pallas(q, cache_k, cache_v, pos, sm_scale, block_k,
+                             interpret):
+    b, s, nh, hd = q.shape
+    nkv, max_len = cache_k.shape[1], cache_k.shape[2]
+    g = nh // nkv
+    bk = _pick_block(max_len, min(block_k, max_len))
+    # [b, s, nkv, g, hd] -> [b, nkv, s*g, hd], query-major per kv head
+    q4 = jnp.swapaxes(q.reshape(b, s, nkv, g, hd), 1, 2) \
+            .reshape(b, nkv, s * g, hd)
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, s * g, hd),
+                         lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, max_len, hd),
+                         lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, max_len, hd),
+                         lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, s * g, hd),
+                               lambda bi, hi, pos_ref: (bi, hi, 0, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_window_kernel, block_k=bk, sm_scale=sm_scale,
+                          gsize=g, window=s, kv_blocks=max_len // bk),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, s * g, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(pos_arr, q4, cache_k, cache_v)
+    return jnp.swapaxes(out.reshape(b, nkv, s, g, hd), 1, 2) \
+              .reshape(b, s, nh, hd)
+
+
+def _window_attention_xla(q, cache_k, cache_v, pos, sm_scale):
+    """Masked full-length reference (what `generation._cached_attention`
+    computes for a window) — fallback and parity oracle."""
+    b, s, nh, hd = q.shape
+    nkv, max_len = cache_k.shape[1], cache_k.shape[2]
+    if nkv != nh:
+        cache_k = jnp.repeat(cache_k, nh // nkv, axis=1)
+        cache_v = jnp.repeat(cache_v, nh // nkv, axis=1)
+    qh = jnp.swapaxes(q, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, cache_k) * sm_scale
+    key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len), 3)
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len), 2)
+    qpos = jnp.asarray(pos, jnp.int32).reshape(-1, 1, 1, 1) + row_iota
+    scores = jnp.where(key_pos <= qpos, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(cache_v.dtype),
+                      cache_v)
+    return jnp.swapaxes(attn, 1, 2)
+
+
+def window_decode_attention(q, cache_k, cache_v, pos, scale=None,
+                            block_k=512):
+    """Attention of a SHORT query window q [b, s, nh, hd] over the
+    fixed-size cache [b, nkv, max_len, hd]: query i of row r sits at
+    position pos[r] + i and attends keys [0, pos[r] + i]. pos may be a
+    scalar (one row / uniform rows — the chunk-offset prefill) or an
+    int32 [b] vector (per-row offsets — the speculative-verify window).
+    Pallas on TPU for windows up to 64 flattened query rows (the online
+    max/sum stops at the last query's watermark; GQA native), the masked
+    jnp composition elsewhere."""
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    use_pallas, interpret = _mode()
+    if use_pallas and window_supported(q.shape, cache_k.shape,
+                                       q.dtype.itemsize):
+        try:
+            return _window_attention_pallas(q, cache_k, cache_v, pos,
+                                            sm_scale, block_k, interpret)
+        except Exception as e:  # lowering constraints supports() can't model
+            import warnings
+
+            warnings.warn(
+                f"Pallas window attention failed ({type(e).__name__}: "
+                f"{e}); falling back to the XLA path for q={q.shape} "
+                f"cache={cache_k.shape}")
+    return _window_attention_xla(q, cache_k, cache_v, pos, sm_scale)
 
 
 # ---------------------------------------------------------------------------

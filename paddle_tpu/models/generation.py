@@ -40,7 +40,8 @@ from paddle_tpu.models import llama_functional as lf
 
 __all__ = ["generate", "params_from_layer", "prefill", "decode_step",
            "paged_decode_step", "gpt_generate", "gpt_params_from_layer",
-           "GPTGenArgs", "QuantizedWeight", "quantize_params"]
+           "GPTGenArgs", "QuantizedWeight", "quantize_params",
+           "draft_from_params"]
 
 
 class QuantizedWeight(NamedTuple):
@@ -86,6 +87,29 @@ def _wmm(x, w):
     return x @ w
 
 
+def _tp_reduce(x, tp_axis):
+    """Row-parallel output reduction for the tensor-parallel decode path:
+    psum over the mp axis inside shard_map (the Megatron pattern
+    llama_functional.decoder_layer uses for training), identity when the
+    forward runs unsharded."""
+    return x if tp_axis is None else jax.lax.psum(x, tp_axis)
+
+
+def draft_from_params(params, args, num_layers):
+    """Truncate a Llama functional tree to its first `num_layers` decoder
+    layers (embedding/final_norm/lm_head shared) — a cheap draft model for
+    speculative decoding whose early-layer predictions track the full
+    target closely. Works on float and `quantize_params` trees (stacked
+    QuantizedWeight leaves slice like plain weights). Returns
+    (draft_params, draft_args)."""
+    if not 1 <= num_layers <= args.num_layers:
+        raise ValueError(
+            f"draft must keep 1..{args.num_layers} layers, got {num_layers}")
+    layers = jax.tree_util.tree_map(lambda x: x[:num_layers],
+                                    params["layers"])
+    return dict(params, layers=layers), args._replace(num_layers=num_layers)
+
+
 def params_from_layer(model):
     """Stack an eager `LlamaForCausalLM`/`LlamaModel`'s weights into the
     functional tree `llama_functional` uses (layers stacked on a leading
@@ -129,15 +153,23 @@ def _cached_attention(q, cache_k, cache_v, pos):
 
     pos: scalar (every row at the same depth — the compiled generate), or
     an int32 [b] vector of per-row positions (continuous-batching decode:
-    each slot at its own depth; requires s == 1)."""
+    each slot at its own depth; with s > 1 query row i of batch row r sits
+    at pos[r] + i — the speculative-verify window)."""
     b, s, nh, hd = q.shape
     nkv, max_len = cache_k.shape[1], cache_k.shape[2]
-    if s == 1:
-        from paddle_tpu.kernels import quantized_matmul as qm
+    from paddle_tpu.kernels import quantized_matmul as qm
 
+    if s == 1:
         if qm.fused_enabled() and qm.decode_supported(
                 q.shape, cache_k.shape, q.dtype.itemsize):
             return qm.decode_attention(q, cache_k, cache_v, pos)
+    elif qm.fused_enabled() and qm.window_supported(
+            q.shape, cache_k.shape, q.dtype.itemsize):
+        # a SHORT query window at a traced offset — the chunk-offset
+        # prefill tail and the speculative-verify window ride the Pallas
+        # window kernel (online max/sum bounded to the last query's
+        # watermark) instead of re-softmaxing the padded cache length
+        return qm.window_decode_attention(q, cache_k, cache_v, pos)
     if nkv != nh:
         rep = nh // nkv
         kh = jnp.repeat(cache_k, rep, axis=1)
@@ -147,11 +179,11 @@ def _cached_attention(q, cache_k, cache_v, pos):
     qh = jnp.swapaxes(q, 1, 2)
     scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(hd)
     key_pos = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len), 3)
+    row_iota = jax.lax.broadcasted_iota(jnp.int32, (1, 1, s, max_len), 2)
     if jnp.ndim(pos) == 1:
-        query_pos = jnp.asarray(pos).reshape(b, 1, 1, 1)  # s == 1 per row
+        query_pos = jnp.asarray(pos).reshape(b, 1, 1, 1) + row_iota
     else:
-        query_pos = pos + jax.lax.broadcasted_iota(
-            jnp.int32, (1, 1, s, max_len), 2)
+        query_pos = pos + row_iota
     scores = jnp.where(key_pos <= query_pos, scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
     attn = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vh.dtype), vh)
@@ -167,7 +199,8 @@ def _rope_rows(q, k, cos_r, sin_r):
                                sin_r[:, None, None, :])
 
 
-def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
+def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args,
+                tp_axis=None, tp_degree=1):
     """One decoder layer over `h` [b, s, hid] with a fixed-size cache.
 
     prefill (pos == 0, s == prompt len): causal attention within the
@@ -177,11 +210,17 @@ def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
 
     pos may be an int32 [b] vector (requires s == 1): every row sits at its
     own position — per-row RoPE, per-row cache-slot writes, per-row
-    attention masking. This is the continuous-batching decode step."""
+    attention masking. This is the continuous-batching decode step.
+
+    tp_axis/tp_degree: when set, this body runs inside shard_map over a
+    tensor-parallel mesh axis — lp holds the Megatron shards (wq/wk/wv/
+    w_gate/w_up split on the out dim, wo/w_down on the in dim), the cache
+    holds this device's nkv/tp_degree heads, and the row-parallel outputs
+    are psum-reduced so `h` stays replicated."""
     b, s = h.shape[0], h.shape[1]
-    nh = args.num_heads
-    nkv = args.num_kv_heads
-    hd = args.hidden_size // nh
+    nh = args.num_heads // tp_degree
+    nkv = args.num_kv_heads // tp_degree
+    hd = args.hidden_size // args.num_heads
 
     hin = lf.rms_norm(h, lp["ln1"], args.rms_eps)
     q = _wmm(hin, lp["wq"]).reshape(b, s, nh, hd)
@@ -213,16 +252,16 @@ def _layer_step(lp, h, cache_k, cache_v, pos, cos, sin, args):
 
     attn = _cached_attention(q, cache_k, cache_v, pos)
     attn = attn.reshape(b, s, nh * hd)
-    h = h + _wmm(attn, lp["wo"])
+    h = h + _tp_reduce(_wmm(attn, lp["wo"]), tp_axis)
 
     hin = lf.rms_norm(h, lp["ln2"], args.rms_eps)
     act = jax.nn.silu(_wmm(hin, lp["w_gate"])) * _wmm(hin, lp["w_up"])
-    h = h + _wmm(act, lp["w_down"])
+    h = h + _tp_reduce(_wmm(act, lp["w_down"]), tp_axis)
     return h, cache_k, cache_v
 
 
 def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args,
-                    last_idx=None):
+                    last_idx=None, tp_axis=None, tp_degree=1):
     """ids [b, s] -> (next-token logits [b, vocab], new caches).
 
     last_idx: optional traced per-row (or scalar) index of the LAST REAL
@@ -234,7 +273,8 @@ def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args,
     def step(carry, xs):
         h = carry
         lp, ck, cv = xs
-        h, ck, cv = _layer_step(lp, h, ck, cv, pos, cos, sin, args)
+        h, ck, cv = _layer_step(lp, h, ck, cv, pos, cos, sin, args,
+                                tp_axis, tp_degree)
         return h, (ck, cv)
 
     h, (new_k, new_v) = jax.lax.scan(step, h,
@@ -251,7 +291,7 @@ def _forward_cached(params, ids, caches_k, caches_v, pos, cos, sin, args,
 
 
 def _layer_step_paged(lp, h, pool_k_l, pool_v_l, bt, pos, cos, sin, args,
-                      page_size):
+                      page_size, tp_axis=None, tp_degree=1):
     """One decoder layer's decode step (s == 1) over a PAGED KV cache.
 
     pool_k_l/pool_v_l: this layer's page pool [num_pages, nkv, ps, hd];
@@ -260,13 +300,17 @@ def _layer_step_paged(lp, h, pool_k_l, pool_v_l, bt, pos, cos, sin, args,
     pos: int32 [b] per-row write positions. Each row's new k/v is
     SCATTERED to (bt[r, pos[r]//ps], pos[r] % ps) — write-before-attend,
     like the stripe path — then attention gathers K/V through the block
-    table (Pallas paged kernel on TPU, jnp gather elsewhere)."""
+    table (Pallas paged kernel on TPU, jnp gather elsewhere).
+
+    tp_axis/tp_degree: shard_map tensor parallelism — weight shards as in
+    `_layer_step`, the page pool sharded on nkv (block tables replicated,
+    every device walks the same tables over its own kv-head slice)."""
     b, s = h.shape[0], h.shape[1]
     if s != 1:
         raise ValueError(f"paged decode requires s == 1 (got s={s})")
-    nh = args.num_heads
-    nkv = args.num_kv_heads
-    hd = args.hidden_size // nh
+    nh = args.num_heads // tp_degree
+    nkv = args.num_kv_heads // tp_degree
+    hd = args.hidden_size // args.num_heads
     ps = page_size
 
     hin = lf.rms_norm(h, lp["ln1"], args.rms_eps)
@@ -295,16 +339,71 @@ def _layer_step_paged(lp, h, pool_k_l, pool_v_l, bt, pos, cos, sin, args,
         # eligible) — table order IS sequence order, so positions line up
         attn = _cached_attention(q, qm.paged_gather(pool_k_l, bt),
                                  qm.paged_gather(pool_v_l, bt), pos)
-    h = h + _wmm(attn.reshape(b, 1, nh * hd), lp["wo"])
+    h = h + _tp_reduce(_wmm(attn.reshape(b, 1, nh * hd), lp["wo"]), tp_axis)
 
     hin = lf.rms_norm(h, lp["ln2"], args.rms_eps)
     act = jax.nn.silu(_wmm(hin, lp["w_gate"])) * _wmm(hin, lp["w_up"])
-    h = h + _wmm(act, lp["w_down"])
+    h = h + _tp_reduce(_wmm(act, lp["w_down"]), tp_axis)
+    return h, pool_k_l, pool_v_l
+
+
+def _layer_step_paged_verify(lp, h, pool_k_l, pool_v_l, bt, pos, limit,
+                             cos, sin, args, page_size, tp_axis=None,
+                             tp_degree=1):
+    """One decoder layer over a SPECULATION WINDOW of s draft tokens
+    against the paged cache: query i of row r sits at position pos[r]+i.
+
+    The s new k/v of each row scatter into its tail pages
+    (write-before-attend; the host pre-allocates pages through
+    pos+s-1, COW-cleared). Writes past `limit[r]` — the row's last legal
+    KV index, i.e. beyond its admission-time page reservation — are
+    REDIRECTED to the null page (the garbage sink): a row about to finish
+    never touches pages it does not own, and the position mask keeps the
+    skipped slots unread. Attention gathers the row's whole table and
+    masks per row per query (`_cached_attention`'s vector-pos branch)."""
+    b, s = h.shape[0], h.shape[1]
+    nh = args.num_heads // tp_degree
+    nkv = args.num_kv_heads // tp_degree
+    hd = args.hidden_size // args.num_heads
+    ps = page_size
+
+    hin = lf.rms_norm(h, lp["ln1"], args.rms_eps)
+    q = _wmm(hin, lp["wq"]).reshape(b, s, nh, hd)
+    k = _wmm(hin, lp["wk"]).reshape(b, s, nkv, hd)
+    v = _wmm(hin, lp["wv"]).reshape(b, s, nkv, hd)
+    prow = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # [b, s]
+    cos_r = jnp.take(cos, prow, axis=0)                      # [b, s, hd]
+    sin_r = jnp.take(sin, prow, axis=0)
+    q, k = lf.apply_rope_bcast(q, k, cos_r[:, :, None, :],
+                               sin_r[:, :, None, :])
+
+    page = jnp.take_along_axis(bt, prow // ps, axis=1)       # [b, s]
+    page = jnp.where(prow <= limit[:, None], page, 0)        # null-page sink
+    off = prow % ps
+    pool_k_l = pool_k_l.at[page.reshape(-1), :, off.reshape(-1)].set(
+        k.reshape(b * s, nkv, hd))
+    pool_v_l = pool_v_l.at[page.reshape(-1), :, off.reshape(-1)].set(
+        v.reshape(b * s, nkv, hd))
+
+    from paddle_tpu.kernels import quantized_matmul as qm
+
+    # gather the row's table and run the window through the shared masked
+    # attention (its vector-pos s>1 branch: query i of row r at pos[r]+i).
+    # s is tiny (draft length + 1), so gather-then-mask is the dispatch on
+    # every backend; a fused window kernel is a follow-up once
+    # TPU-measured numbers justify it
+    attn = _cached_attention(q, qm.paged_gather(pool_k_l, bt),
+                             qm.paged_gather(pool_v_l, bt), pos)
+    h = h + _tp_reduce(_wmm(attn.reshape(b, s, nh * hd), lp["wo"]), tp_axis)
+
+    hin = lf.rms_norm(h, lp["ln2"], args.rms_eps)
+    act = jax.nn.silu(_wmm(hin, lp["w_gate"])) * _wmm(hin, lp["w_up"])
+    h = h + _tp_reduce(_wmm(act, lp["w_down"]), tp_axis)
     return h, pool_k_l, pool_v_l
 
 
 def _paged_forward_decode(params, ids, pool_k, pool_v, bt, pos, cos, sin,
-                          args, page_size):
+                          args, page_size, tp_axis=None, tp_degree=1):
     """ids [b, 1] -> (next-token logits [b, vocab], new pools). The paged
     analogue of `_forward_cached`'s decode step: pools are [L, num_pages,
     nkv, ps, hd] and slice per layer under the same lax.scan."""
@@ -314,13 +413,39 @@ def _paged_forward_decode(params, ids, pool_k, pool_v, bt, pos, cos, sin,
         h = carry
         lp, pk, pv = xs
         h, pk, pv = _layer_step_paged(lp, h, pk, pv, bt, pos, cos, sin,
-                                      args, page_size)
+                                      args, page_size, tp_axis, tp_degree)
         return h, (pk, pv)
 
     h, (new_k, new_v) = jax.lax.scan(step, h,
                                      (params["layers"], pool_k, pool_v))
     h = lf.rms_norm(h, params["final_norm"], args.rms_eps)
     logits = _wmm(h[:, -1, :], params["lm_head"])
+    return logits.astype(jnp.float32), new_k, new_v
+
+
+def _paged_forward_verify(params, ids, pool_k, pool_v, bt, pos, limit,
+                          cos, sin, args, page_size, tp_axis=None,
+                          tp_degree=1):
+    """Speculative-verify forward: ids [b, s] (the last committed token
+    followed by s-1 draft tokens, row r's token i at position pos[r]+i)
+    -> (logits [b, s, vocab] at EVERY window position, new pools). One
+    batched program scores a whole draft window — the target-model half
+    of speculative decoding (Leviathan et al.; greedy exact-match
+    acceptance happens on host)."""
+    h = jnp.take(params["embedding"], ids, axis=0)
+
+    def step(carry, xs):
+        h = carry
+        lp, pk, pv = xs
+        h, pk, pv = _layer_step_paged_verify(
+            lp, h, pk, pv, bt, pos, limit, cos, sin, args, page_size,
+            tp_axis, tp_degree)
+        return h, (pk, pv)
+
+    h, (new_k, new_v) = jax.lax.scan(step, h,
+                                     (params["layers"], pool_k, pool_v))
+    h = lf.rms_norm(h, params["final_norm"], args.rms_eps)
+    logits = _wmm(h, params["lm_head"])
     return logits.astype(jnp.float32), new_k, new_v
 
 
@@ -342,26 +467,76 @@ def paged_decode_step(params, args, token, pool_k, pool_v, block_tables,
         cos, sin, args, int(page_size))
 
 
-def _sample(logits, sample, temperature, top_p, key):
-    """sample is the only STATIC switch (argmax vs categorical program
-    structure); temperature/top_p are traced, so serving can vary them per
-    request without recompiling the decode program."""
+def _row_keys(seeds, pos):
+    """Per-request sampling keys [b]: fold (seed, position) into a fixed
+    base key — a request's randomness is a pure function of its own seed
+    and the position being sampled, independent of batch composition.
+    THE one derivation shared by `generate(seeds=...)` and the serving
+    engines' per-slot sampler (the documented common key stream)."""
+    base = jax.vmap(
+        lambda s: jax.random.fold_in(jax.random.key(0), s))(seeds)
+    return jax.vmap(jax.random.fold_in)(
+        base, jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1),
+                               (base.shape[0],)))
+
+
+def _sample(logits, sample, temperature, top_p, key, top_k=0,
+            row_keys=None):
+    """The per-request sampler. `sample` is the only STATIC switch (argmax
+    vs categorical program structure); temperature/top_p/top_k are traced
+    scalars OR per-row [b] vectors, so serving can vary them per request —
+    per SLOT — without recompiling the decode program. Rows with
+    temperature <= 0 stay exactly greedy (argmax), which is what keeps a
+    greedy request's output bit-identical inside a mixed sampling batch.
+
+    top_k <= 0 disables the top-k mask (all of vocab survives); top_p and
+    top_k compose (k-mask first, nucleus over what remains — the
+    huggingface/vLLM order). Sampling draws from `key` (one shared PRNG
+    stream, split by the caller per step) or, when `row_keys` [b] is
+    given, per-row gumbel-max draws — the per-request-seed path, where a
+    request's randomness depends only on its own seed and position, not
+    on which other requests share its batch."""
     if not sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
-    # nucleus mask (a no-op when top_p == 1.0: the cutoff lands on the
-    # smallest logit and everything survives)
-    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    b, vocab = logits.shape
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    greedy_rows = t <= 0.0
+    scaled = logits / jnp.where(greedy_rows, 1.0, t)[:, None]
+
+    # top-k: mask everything below the k-th largest (k <= 0 or >= vocab
+    # keeps all). Computed on the DESCENDING sort shared with top-p.
+    k_vec = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    k_eff = jnp.where(k_vec <= 0, vocab, jnp.minimum(k_vec, vocab))
+    sorted_logits = jnp.sort(scaled, axis=-1)[:, ::-1]
+    rank = jax.lax.broadcasted_iota(jnp.int32, (b, vocab), 1)
+    kth = jnp.take_along_axis(sorted_logits, (k_eff - 1)[:, None], axis=-1)
+    sorted_masked = jnp.where(rank < k_eff[:, None], sorted_logits, -1e30)
+
+    # nucleus mask over the k-survivors (a no-op when top_p == 1.0: the
+    # cutoff lands on the smallest surviving logit and everything stays)
+    p_vec = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32).reshape(-1),
+                             (b,))[:, None]
+    probs = jax.nn.softmax(sorted_masked, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
-    cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-    logits = jnp.where(logits >= cutoff, logits, -1e30)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    cutoff_idx = jnp.sum(cum < p_vec, axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_masked, cutoff_idx, axis=-1)
+    masked = jnp.where((scaled >= cutoff) & (scaled >= kth), scaled, -1e30)
+
+    if row_keys is not None:
+        # gumbel-max: argmax(logits + g) ~ categorical(softmax(logits)),
+        # one independent draw per row from that row's own key
+        u = jax.vmap(lambda k_: jax.random.uniform(
+            k_, (vocab,), jnp.float32, minval=1e-20, maxval=1.0))(row_keys)
+        drawn = jnp.argmax(masked - jnp.log(-jnp.log(u)), axis=-1)
+    else:
+        drawn = jax.random.categorical(key, masked, axis=-1)
+    return jnp.where(greedy_rows, jnp.argmax(logits, axis=-1),
+                     drawn).astype(jnp.int32)
 
 
 def _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
-                 temperature, top_p, key, use_eos=False, eos_id=0, pad_id=0):
+                 temperature, top_p, key, use_eos=False, eos_id=0, pad_id=0,
+                 top_k=0, seeds=None):
     """Shared prefill->sample->scan->concat driver (traced inside the
     per-architecture jit): fwd(ids, ck, cv, pos) -> (logits, ck, cv).
 
@@ -374,9 +549,16 @@ def _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
     loop — but finished rows carry a done mask, matching the reference's
     eager stopping criterion semantically."""
     b, s = prompt_ids.shape
+
+    def rkeys(pos):
+        # per-request seeds: a row's key depends only on (its seed, the
+        # position being sampled) — stable across batch compositions
+        return None if seeds is None else _row_keys(seeds, pos)
+
     logits, ck, cv = fwd(prompt_ids, ck, cv, 0)
     key, sub = jax.random.split(key)
-    first = _sample(logits, sample, temperature, top_p, sub)
+    first = _sample(logits, sample, temperature, top_p, sub, top_k,
+                    rkeys(jnp.int32(s)))
     done0 = first == eos_id if use_eos else jnp.zeros((b,), bool)
     if max_new_tokens == 1:
         return jnp.concatenate([prompt_ids, first[:, None]], axis=1)
@@ -385,7 +567,8 @@ def _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
         token, ck, cv, pos, key, done = carry
         logits, ck, cv = fwd(token[:, None], ck, cv, pos)
         key, sub = jax.random.split(key)
-        nxt = _sample(logits, sample, temperature, top_p, sub)
+        nxt = _sample(logits, sample, temperature, top_p, sub, top_k,
+                      rkeys(pos + 1))
         if use_eos:
             nxt = jnp.where(done, pad_id.astype(jnp.int32), nxt)
             done = done | (nxt == eos_id)
@@ -440,34 +623,44 @@ def decode_step(params, args, token, caches_k, caches_v, pos, max_len):
 
 
 def generate(params, args, prompt_ids, max_new_tokens=32, temperature=0.0,
-             top_p=1.0, key=None, eos_token_id=None, pad_token_id=0):
+             top_p=1.0, key=None, eos_token_id=None, pad_token_id=0,
+             top_k=0, seeds=None):
     """Whole generation as one compiled program.
 
     prompt_ids: [b, s] int32. Returns [b, s + max_new_tokens] int32.
-    temperature 0 = greedy; top_p < 1 = nucleus sampling. temperature and
-    top_p are traced (vary per call without recompiling); only the
-    greedy/sampling mode switch and shapes are compile-time.
+    temperature 0 = greedy; top_p < 1 = nucleus sampling; top_k > 0 keeps
+    only the k largest logits. temperature/top_p/top_k are traced and may
+    be scalars or per-row [b] vectors (vary per call and per request
+    without recompiling); only the greedy/sampling mode switch and shapes
+    are compile-time.
+    seeds: optional per-row int seeds [b]. Each row then samples from its
+    own (seed, position)-derived PRNG stream — the same row with the same
+    seed reproduces its tokens regardless of what else is in the batch.
     eos_token_id: rows that emit it produce pad_token_id afterwards (the
     output stays rectangular)."""
     if max_new_tokens <= 0:
         return jnp.asarray(prompt_ids)
     if key is None:
         key = jax.random.key(0)
-    sample = bool(np.asarray(temperature) != 0.0)
+    sample = bool(np.any(np.asarray(temperature) != 0.0))
     use_eos = eos_token_id is not None
     return _generate_jit(params, args, jnp.asarray(prompt_ids),
                          max_new_tokens, sample,
-                         jnp.float32(temperature if sample else 1.0),
-                         jnp.float32(top_p), key, use_eos,
+                         jnp.asarray(temperature if sample else 1.0,
+                                     jnp.float32),
+                         jnp.asarray(top_p, jnp.float32), key, use_eos,
                          jnp.int32(eos_token_id if use_eos else 0),
-                         jnp.int32(pad_token_id))
+                         jnp.int32(pad_token_id),
+                         jnp.asarray(top_k, jnp.int32),
+                         (None if seeds is None
+                          else jnp.asarray(seeds, jnp.int32)))
 
 
 @functools.partial(jax.jit, static_argnames=("args", "max_new_tokens",
                                              "sample", "use_eos"))
 def _generate_jit(params, args, prompt_ids, max_new_tokens, sample,
                   temperature, top_p, key, use_eos=False, eos_id=0,
-                  pad_id=0):
+                  pad_id=0, top_k=0, seeds=None):
     b, s = prompt_ids.shape
     max_len = s + max_new_tokens
     ck, cv, cos, sin = _init_cache(params, args, b, max_len)
@@ -477,7 +670,8 @@ def _generate_jit(params, args, prompt_ids, max_new_tokens, sample,
 
     return _decode_loop(fwd, prompt_ids, ck, cv, max_new_tokens, sample,
                         temperature, top_p, key, use_eos,
-                        jnp.asarray(eos_id), jnp.asarray(pad_id))
+                        jnp.asarray(eos_id), jnp.asarray(pad_id),
+                        jnp.asarray(top_k), seeds)
 
 
 # --------------------------------------------------------------------------

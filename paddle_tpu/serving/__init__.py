@@ -13,11 +13,32 @@ Two engines share one iteration-level scheduler (Orca-style):
     Admission allocates pages on demand (worst case reserved up front),
     so far more concurrent requests fit the same KV HBM.
 
+The paged engine stacks the three serving-throughput levers (ISSUE 14),
+all preserving exact greedy parity with sequential `generate`:
+
+  - TENSOR PARALLELISM: `PagedEngine(mesh=...)` runs every step as a
+    shard_map SPMD program over a mesh `mp` axis — Megatron weight
+    shards, page pool sharded on nkv, block tables replicated
+    (`serving/tp.py` placement);
+  - CHUNKED PREFILL: `prefill_chunk=` streams long prompts in
+    page-aligned chunks interleaved with decode steps (+ anti-convoy
+    short-prompt bypass), keeping TTFT flat under long-prompt bursts;
+  - SPECULATIVE DECODING (`serving/spec_decode.py`): `draft_params=`/
+    `draft_args=` (see `generation.draft_from_params`) propose
+    `spec_tokens` draft tokens in one traced scan and verify the window
+    in one batched paged forward — greedy exact-match acceptance, then
+    the block table rolls back to the committed watermark (rejected
+    window pages return to the pool);
+  - per-request sampling (`serving/sampler.py`): `Request(temperature=,
+    top_p=, top_k=, seed=)` as traced per-row vectors (greedy rows stay
+    bit-exact argmax in mixed batches; seeds make tokens
+    batch-independent).
+
 `serving/scheduler.py` holds the admission queue / length buckets /
 slot table / page math; `serving/metrics.py` the counters (queue depth,
 TTFT, tokens/sec, occupancy, compile counts, prefix-cache hit rate,
-pages in use/free, COW copies) that also back
-`inference.Config.enable_profile()`.
+pages in use/free, COW copies, prefill chunks, draft proposed/accepted)
+that also back `inference.Config.enable_profile()`.
 
     from paddle_tpu.serving import PagedEngine, Request
 
@@ -29,18 +50,21 @@ pages in use/free, COW copies) that also back
     print(eng.metrics.summary())
 
 `bench.py --serving` replays deterministic arrival traces
-(`tools/serving_trace.py`, incl. shared-prefix traces) and reports
-throughput + TTFT vs sequential `generate`, plus a stripe-vs-paged
-comparison at equal KV-cache HBM.
+(`tools/serving_trace.py`, incl. shared-prefix and mixed long/short
+traces) and reports throughput + TTFT vs sequential `generate`, plus a
+stripe-vs-paged comparison at equal KV-cache HBM, a chunked-vs-
+monolithic TTFT leg, and a speculative-vs-greedy tokens/sec leg.
 """
 
 from paddle_tpu.serving.block_manager import NULL_PAGE, BlockAllocator
 from paddle_tpu.serving.engine import Engine, Request
 from paddle_tpu.serving.metrics import Metrics
 from paddle_tpu.serving.paged_engine import PagedEngine
+from paddle_tpu.serving.sampler import SlotSampler
 from paddle_tpu.serving.scheduler import (AdmissionQueue, SlotTable,
                                           bucket_for, pages_for)
+from paddle_tpu.serving.spec_decode import SpecDecoder
 
 __all__ = ["Engine", "PagedEngine", "Request", "Metrics", "BlockAllocator",
-           "NULL_PAGE", "AdmissionQueue", "SlotTable", "bucket_for",
-           "pages_for"]
+           "NULL_PAGE", "AdmissionQueue", "SlotTable", "SlotSampler",
+           "SpecDecoder", "bucket_for", "pages_for"]

@@ -60,6 +60,11 @@ class BlockAllocator:
         self._key_of = {}           # registered page -> its table key
         self._parent = {}           # registered page -> parent page (or -1)
         self._children = {}         # page -> set of registered child pages
+        # bumped whenever the prefix table changes — lets callers memoize
+        # side-effect-free match_prefix scans (the chunked-prefill
+        # anti-convoy admission walk) until a registration or eviction
+        # could change the answer
+        self.prefix_version = 0
         self._gauges()
 
     # -- introspection ------------------------------------------------------
@@ -210,6 +215,7 @@ class BlockAllocator:
             if parent != -1:
                 self._children.setdefault(parent, set()).add(p)
             parent = p
+            self.prefix_version += 1
 
     # -- eviction -----------------------------------------------------------
     def _evict_lru(self):
@@ -229,6 +235,7 @@ class BlockAllocator:
         key = self._key_of.pop(page, None)
         if key is None:
             return
+        self.prefix_version += 1
         self._table.pop(key, None)
         parent = self._parent.pop(page, None)
         if parent is not None and parent != -1:

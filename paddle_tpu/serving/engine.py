@@ -25,9 +25,13 @@ decode machinery:
     write-before-attend order means stale tail positions are always
     overwritten before the position mask ever exposes them.
 
-Greedy decoding (the scheduler retires rows on exact token identity, so
-continuous-batched output is token-for-token identical to sequential
-`generate` — tested). Weight-only int8 trees from
+Greedy decoding by default (the scheduler retires rows on exact token
+identity, so continuous-batched output is token-for-token identical to
+sequential `generate` — tested); per-request sampling
+(temperature/top-p/top-k + per-request seeds, `Request(...)`) rides the
+same decode program as traced per-row vectors — greedy rows stay
+bit-exact argmax inside a mixed batch, and greedy-only traffic never
+compiles the sampling ops. Weight-only int8 trees from
 `generation.quantize_params` serve unchanged: every matmul inside the
 traced step streams through the fused dequant-matmul dispatch.
 
@@ -57,6 +61,7 @@ import numpy as np
 from paddle_tpu.models import generation as gen
 from paddle_tpu.models import llama_functional as lf
 from paddle_tpu.serving.metrics import Metrics
+from paddle_tpu.serving.sampler import SlotSampler, pick as _pick
 from paddle_tpu.serving.scheduler import AdmissionQueue, SlotTable, bucket_for
 
 __all__ = ["Request", "Engine"]
@@ -70,11 +75,22 @@ class Request:
     stream_cb(request, token_id, finished) fires once per generated token,
     in emission order, from the host scheduler (never inside traced code).
     After completion: `token_ids` (generated tokens, incl. the EOS if one
-    was emitted), `finish_reason` ('eos' | 'length'), `ttft_s`.
+    was emitted), `finish_reason` ('eos' | 'length'), `ttft_s` (first
+    EMITTED token), `prefill_done_s` (prompt fully in the KV cache —
+    under chunked prefill the two diverge, see Engine._record_prefill_done).
+
+    Sampling: temperature 0 (default) is exactly greedy; temperature > 0
+    samples with optional nucleus top_p and top-k cutoffs. `seed` fixes
+    the request's own PRNG stream — the sampled tokens depend only on
+    (seed, position), not on which other requests share its batch steps
+    (default: the request id, so trace replays are deterministic). All
+    four are PER-REQUEST and traced: a mixed greedy/sampling batch runs
+    one program, greedy rows staying bit-exact argmax.
     """
 
     def __init__(self, prompt_ids, max_new_tokens=32, eos_token_id=None,
-                 stream_cb=None, request_id=None):
+                 stream_cb=None, request_id=None, temperature=0.0,
+                 top_p=1.0, top_k=0, seed=None):
         self.prompt_ids = np.asarray(prompt_ids, np.int32).reshape(-1)
         if self.prompt_ids.size == 0:
             raise ValueError("empty prompt")
@@ -86,6 +102,24 @@ class Request:
         self.stream_cb = stream_cb
         self.request_id = (next(_req_ids) if request_id is None
                            else request_id)
+        self.temperature = float(temperature)
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        self.top_p = float(top_p)
+        self.top_k = int(top_k)
+        if seed is None:
+            try:
+                seed = int(self.request_id)
+            except (TypeError, ValueError):
+                # stable across processes (hash() of str is randomized
+                # per interpreter — it would break deterministic replays)
+                import zlib
+
+                seed = zlib.crc32(str(self.request_id).encode())
+        # one normalization point: every consumer (engine programs AND a
+        # user passing req.seed to generate(seeds=...)) sees the same
+        # non-negative int32
+        self.seed = int(seed) & 0x7FFFFFFF
         self.token_ids = []
         self.finished = False
         self.finish_reason = None
@@ -95,6 +129,8 @@ class Request:
         self.finish_time = None
         self.ttft_s = None
         self.ttft_steps = None
+        self.prefill_done_s = None
+        self.prefill_done_steps = None
 
     def output_ids(self):
         """prompt + generated tokens (the sequential-generate row shape,
@@ -103,26 +139,31 @@ class Request:
             [self.prompt_ids, np.asarray(self.token_ids, np.int32)])
 
 
-def _prefill_traced(params, ids, true_len, ck, cv, slot, cos, sin, *,
-                    args, metrics):
+def _prefill_traced(params, ids, true_len, ck, cv, slot, cos, sin, temp,
+                    top_p, top_k, seeds, *, args, metrics, sample=False,
+                    counter="prefill_compiles"):
     # runs once per COMPILE (trace time), not per call — see metrics.py
-    metrics.inc("prefill_compiles")
+    metrics.inc(counter)
     L = ck.shape[0]
     sck = jnp.zeros((L, 1) + ck.shape[2:], ck.dtype)
     scv = jnp.zeros_like(sck)
     logits, sck, scv = gen._forward_cached(
         params, ids, sck, scv, 0, cos, sin, args, last_idx=true_len - 1)
-    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+    first = _pick(logits, sample, temp, top_p, top_k, seeds, true_len)[0]
     ck = jax.lax.dynamic_update_slice_in_dim(ck, sck, slot, axis=1)
     cv = jax.lax.dynamic_update_slice_in_dim(cv, scv, slot, axis=1)
     return ck, cv, first
 
 
-def _decode_traced(params, tokens, ck, cv, pos, cos, sin, *, args, metrics):
-    metrics.inc("decode_compiles")
+def _decode_traced(params, tokens, ck, cv, pos, cos, sin, temp, top_p,
+                   top_k, seeds, *, args, metrics, sample=False,
+                   counter="decode_compiles"):
+    metrics.inc(counter)
     logits, ck, cv = gen._forward_cached(
         params, tokens[:, None], ck, cv, pos, cos, sin, args)
-    return ck, cv, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # the sampled token lands at sequence index pos+1 — the same
+    # (seed, position) stream the offline `generate(seeds=...)` draws from
+    return ck, cv, _pick(logits, sample, temp, top_p, top_k, seeds, pos + 1)
 
 
 class Engine:
@@ -151,7 +192,10 @@ class Engine:
         self.slots = SlotTable(self.max_slots)
         self._npos = np.zeros(self.max_slots, np.int32)   # next write pos
         self._last_tok = np.full(self.max_slots, self.pad_id, np.int32)
+        # per-slot sampling state (greedy defaults; set at admission)
+        self.sampler = SlotSampler(self.max_slots)
         self.step_count = 0
+        self._stall_steps = 0     # decode work delayed by a prefill step
         self._setup_device_state()
 
     def _setup_device_state(self):
@@ -179,11 +223,13 @@ class Engine:
         self._prefill = jax.jit(
             functools.partial(_prefill_traced, args=args,
                               metrics=self.metrics),
-            donate_argnums=(3, 4) if donate else ())
+            donate_argnums=(3, 4) if donate else (),
+            static_argnames=("sample",))
         self._decode = jax.jit(
             functools.partial(_decode_traced, args=args,
                               metrics=self.metrics),
-            donate_argnums=(2, 3) if donate else ())
+            donate_argnums=(2, 3) if donate else (),
+            static_argnames=("sample",))
 
     # -- admission ----------------------------------------------------------
     def submit(self, req):
@@ -208,16 +254,38 @@ class Engine:
         and a slot is free (paged engines also require page capacity),
         else one batched decode step over all active slots, else idle.
         Returns a small event dict."""
-        if self._can_prefill():
-            ev = self._prefill_step()
-        elif self.slots.active_slots:
-            ev = self._decode_step()
-        else:
-            ev = {"type": "idle"}
+        ev = self._step_action()
         self.step_count += 1
         self.metrics.observe("slot_occupancy", self.slots.occupancy())
         self.metrics.set_gauge("active_slots", len(self.slots.active_slots))
         return ev
+
+    def _step_action(self):
+        """Pick and run this iteration's unit of work (subclass hook: the
+        paged engine interleaves chunked-prefill streams and swaps decode
+        for speculate-and-verify here)."""
+        if self._can_prefill():
+            self._note_prefill_stall()
+            return self._prefill_step()
+        if self._decodable_slots():
+            return self._decode_step()
+        return {"type": "idle"}
+
+    def _note_prefill_stall(self):
+        """Account one prefill-shaped step taken while decodable slots
+        sat waiting — the `prefill_stall_steps` gauge chunked prefill
+        exists to flatten (a monolithic long prefill stalls every
+        decoding slot for its whole wall time; a chunk stalls them for
+        one bounded chunk)."""
+        if self._decodable_slots():
+            self._stall_steps += 1
+            self.metrics.set_gauge("prefill_stall_steps", self._stall_steps)
+
+    def _decodable_slots(self):
+        """Slots eligible for a batched decode step (subclass hook: the
+        paged engine excludes slots whose prompt is still mid-chunked-
+        prefill)."""
+        return self.slots.active_slots
 
     def _can_prefill(self):
         """True when the next queued request can be admitted this step
@@ -251,7 +319,11 @@ class Engine:
                 t = pending[i]
                 req = Request(t["prompt"], t["max_new_tokens"],
                               eos_token_id=t.get("eos_token_id"),
-                              request_id=t.get("request_id"))
+                              request_id=t.get("request_id"),
+                              temperature=t.get("temperature", 0.0),
+                              top_p=t.get("top_p", 1.0),
+                              top_k=t.get("top_k", 0),
+                              seed=t.get("seed"))
                 out[id(t)] = self.submit(req)
                 i += 1
             self.step()
@@ -263,29 +335,79 @@ class Engine:
         timed replay on one engine without recompiling."""
         if self.queue or self.slots.active_slots:
             raise RuntimeError("reset() with requests still in flight")
+        # every trace-time compile counter survives: warm replay compiles,
+        # reset, timed replay hits the jit cache — wiping any of these
+        # would report 0 programs built for the timed run's artifacts
         self.metrics.reset(keep_counters=("prefill_compiles",
-                                          "decode_compiles"))
+                                          "decode_compiles",
+                                          "verify_compiles",
+                                          "draft_propose_compiles",
+                                          "draft_prefill_compiles"))
         self.queue = AdmissionQueue(self.metrics)
         self.slots = SlotTable(self.max_slots)
         self._npos[:] = 0
         self._last_tok[:] = self.pad_id
+        self.sampler.reset()
         self.step_count = 0
+        self._stall_steps = 0
 
     # -- internals ----------------------------------------------------------
-    def _prefill_step(self):
-        req = self.queue.pop()
+    def _admit(self, req):
+        """Hand the queue head a slot and load its sampling params."""
         slot = self.slots.admit(req)
-        n = int(req.prompt_ids.size)
-        bucket, first = self._prefill_device(req, slot, n)
+        self.sampler.admit(slot, req)
+        return slot
+
+    def _sampling_active(self):
+        """True when any slot in the decode batch samples — selects the
+        decode program variant (greedy-only traffic never compiles the
+        sampling ops). Scoped to the DECODABLE slots: a sampling request
+        still mid-chunked-prefill must not push the greedy rows' decode
+        steps onto the sampling program."""
+        return self.sampler.any_sampling(self._decodable_slots())
+
+    def _record_prefill_done(self, req):
+        """The prompt is fully in the target's KV cache. This is NOT
+        TTFT: under chunked prefill the final chunk stashes the first
+        token but emission waits for the stream to finish (with
+        speculation the draft mirror may still be catching up window by
+        window), so the two diverge by whole engine steps. Telemetry
+        keeps both — `ttft_s` is what a client observes, `prefill_done_s`
+        is what the prefill path costs. Idempotent: the monolithic path
+        reaches here again via _complete_prefill."""
+        if req.prefill_done_s is not None:
+            return
+        now = time.perf_counter()
+        req.prefill_done_s = now - req.submit_time
+        req.prefill_done_steps = self.step_count - req.submit_step
+        self.metrics.observe("prefill_done_s", req.prefill_done_s)
+        self.metrics.observe("prefill_done_steps", req.prefill_done_steps)
+
+    def _record_first_token(self, req):
         now = time.perf_counter()
         req.first_token_time = now
-        # TTFT in wall-clock seconds AND in engine steps: steps are the
+        # TTFT at the first EMITTED token (not prefill completion), in
+        # wall-clock seconds AND engine steps: steps are the
         # load-independent scheduling-delay unit arrival traces are written
         # in; seconds are what ROADMAP 2's p99 acceptance is measured in
         req.ttft_s = now - req.submit_time
         req.ttft_steps = self.step_count - req.submit_step
         self.metrics.observe("ttft_s", req.ttft_s)
         self.metrics.observe("ttft_steps", req.ttft_steps)
+
+    def _prefill_step(self):
+        req = self.queue.pop()
+        slot = self._admit(req)
+        n = int(req.prompt_ids.size)
+        bucket, first = self._prefill_device(req, slot, n)
+        return self._complete_prefill(req, slot, bucket, first, n)
+
+    def _complete_prefill(self, req, slot, bucket, first, n):
+        """Book-keep a finished prompt prefill: TTFT, counters, position,
+        the first emitted token (shared by the monolithic path and the
+        paged engine's final chunk)."""
+        self._record_prefill_done(req)
+        self._record_first_token(req)
         self.metrics.inc("prefills")
         self.metrics.inc("tokens_generated")
         self._npos[slot] = n
@@ -305,12 +427,16 @@ class Engine:
         with self.metrics.timer("prefill_s"):
             self._ck, self._cv, first = self._prefill(
                 self.params, jnp.asarray(padded), jnp.int32(n),
-                self._ck, self._cv, jnp.int32(slot), self._cos, self._sin)
+                self._ck, self._cv, jnp.int32(slot), self._cos, self._sin,
+                jnp.float32(req.temperature), jnp.float32(req.top_p),
+                jnp.int32(req.top_k),
+                jnp.asarray([req.seed], jnp.int32),
+                sample=req.temperature > 0)
             first = int(first)
         return bucket, first
 
     def _decode_step(self):
-        active = self.slots.active_slots
+        active = self._decodable_slots()
         nxt = self._decode_device(active)
         emitted = {}
         for slot in active:
@@ -327,13 +453,17 @@ class Engine:
         self.metrics.observe("tokens_per_decode_step", len(active))
         return {"type": "decode", "tokens": emitted}
 
+    def _sampling_args(self):
+        return self.sampler.device_args()
+
     def _decode_device(self, active):
         """Run the device half of one batched decode step (subclass
         hook). Returns the next-token array [S] on host."""
         with self.metrics.timer("decode_step_s"):
             self._ck, self._cv, nxt = self._decode(
                 self.params, jnp.asarray(self._last_tok), self._ck,
-                self._cv, jnp.asarray(self._npos), self._cos, self._sin)
+                self._cv, jnp.asarray(self._npos), self._cos, self._sin,
+                *self._sampling_args(), sample=self._sampling_active())
         return np.asarray(nxt)
 
     def _emit(self, req, token):
@@ -355,3 +485,4 @@ class Engine:
         self.slots.retire(slot)
         self._npos[slot] = 0
         self._last_tok[slot] = self.pad_id
+        self.sampler.clear(slot)

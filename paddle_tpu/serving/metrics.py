@@ -12,6 +12,14 @@ keeping the original call surface:
     counter as a Python side effect, which runs exactly once per XLA
     compilation — a cached call never re-enters the traced Python, so the
     counter is precisely "programs built");
+  - the chunked-prefill / speculative-decoding series (ROADMAP 1's
+    acceptance metrics): `prefill_stall_steps` gauge (scheduler steps a
+    prefill took while decodable slots waited — the stall chunking
+    flattens), `prefill_chunks`/`chunked_prefills` counters +
+    `chunks_per_prompt` histogram, `spec_acceptance_rate` histogram and
+    `draft_tokens_proposed`/`draft_tokens_accepted` counters (+
+    `spec_commit_len`, `spec_rounds`, `spec_pages_rewound` for the
+    roll-back path);
   - `inference.Config.enable_profile()` — Predictor.run wall time + call
     counts, retrievable via `Predictor.summary()`;
   - `bench.py --serving` — the throughput/TTFT artifact, now with TTFT

@@ -1,4 +1,5 @@
-"""Paged-KV serving engine: block tables + hash-based prefix reuse.
+"""Paged-KV serving engine: block tables, prefix reuse, tensor-parallel
+decode, chunked prefill, and speculative decoding.
 
 `Engine` (serving/engine.py) reserves a full `max_len` KV stripe per
 slot, so HBM — not compute — caps concurrency, and identical system
@@ -9,37 +10,49 @@ scheduler:
 
   - ONE fixed page pool `[L, num_pages, nkv, page_size, hd]` (heads-major
     pages — the layout the Pallas paged decode kernel consumes) and a
-    per-slot BLOCK TABLE mapping sequence positions to pages. A request
-    occupies ceil(len/page_size) pages, not max_len — the fragmentation
-    the stripe engine wastes becomes admission headroom;
+    per-slot BLOCK TABLE mapping sequence positions to pages;
   - PREFIX CACHE: full pages of every prefilled prompt are registered in
-    `BlockAllocator`'s exact-match hash chain. A new request walks the
-    chain, REFS the hit pages (shared, refcounted — the bytes exist
-    once), and prefills only the remaining suffix: a shared system
-    prompt is computed once, then every later request starts decoding
-    after a block-table lookup;
-  - PREFILL = gather the hit pages into a contiguous scratch stripe,
-    run the suffix forward at position h (one program per suffix-length
-    bucket — the compile-count discipline of the stripe engine), scatter
-    the freshly computed pages back into the pool;
-  - DECODE = one batched paged step (`generation._paged_forward_decode`,
-    the traced body behind the public `generation.paged_decode_step`):
-    per-row scatter of the new k/v into each slot's tail page, attention
-    gathered through the block tables (per-row page-index prefetch in
-    the Pallas kernel). The host allocates a tail page exactly when a
-    row's position crosses a page boundary, and `ensure_writable` COWs
-    any page that is shared or hash-registered before it is written;
-  - ADMISSION reserves the request's worst-case page count
-    (`scheduler.pages_for` minus prefix hits) so FIFO requests always
-    finish without preemption; when the pool (free + LRU-evictable
-    cached pages) can't cover the queue head, the engine decodes instead
-    and admits later.
+    `BlockAllocator`'s exact-match hash chain and REF'd by later
+    requests sharing the prefix (refcounted, COW-protected);
+  - PREFILL = gather the hit pages, run the suffix forward at traced
+    position h (one program per suffix-length bucket), scatter the new
+    pages; DECODE = one batched paged step through the block tables;
+  - ADMISSION reserves the worst-case page count minus hits and defers
+    the FIFO head under page pressure.
 
-Greedy parity with the stripe engine and sequential `generate` is exact:
-pages in table order ARE the contiguous cache (gathering them reproduces
-the stripe bit-for-bit), padded-softmax tails underflow to exact zeros,
-and int8 `quantize_params` trees stream through the same fused
-dequant-matmul dispatch.
+On top of that scheduler this engine adds the three serving-throughput
+levers (ROADMAP item 1):
+
+TENSOR PARALLELISM (`mesh=`): pass a Mesh with an `mp` axis and every
+step program runs as one shard_map SPMD program over it — weights in
+the Megatron split, the page pool sharded on its nkv axis, block tables
+and the host-side allocator untouched (`serving/tp.py` has the
+placement; `mesh_utils.shard_map_compat` keeps legacy jax working).
+Model size now scales with the mesh, not one chip's HBM.
+
+CHUNKED PREFILL (`prefill_chunk=`): a long prompt no longer runs as one
+monolithic program that stalls every decoding slot for its whole
+duration. The suffix is split into page-aligned chunks and the
+scheduler INTERLEAVES: chunk, then a decode step (or a short prefill),
+then the next chunk — so TTFT for queued requests stays flat under
+long-prompt bursts. Chunks reuse the suffix-bucket prefill program
+(each chunk is "a suffix at a deeper h"), composing with prefix hits
+unchanged.
+
+SPECULATIVE DECODING (`draft_params=`): a cheap draft model (e.g.
+`generation.draft_from_params` truncation) proposes `spec_tokens`
+greedy tokens in ONE traced scan over its own stripe cache; the target
+model scores the whole window in ONE batched paged verify forward; the
+host commits the longest exactly-matching prefix plus the target's own
+next token (Leviathan-style greedy acceptance — output is token-for-
+token THE target's greedy sequence, just cheaper). Accepted tokens'
+K/V land in the paged tail pages during verify; rejected positions are
+garbage that the write-before-attend order overwrites, and positions
+past a row's page reservation are redirected to the null page.
+
+Greedy parity with sequential `generate` stays exact under every
+combination of the three (and int8 `quantize_params` trees stream
+through the same fused dequant-matmul dispatch).
 """
 
 from __future__ import annotations
@@ -49,32 +62,38 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from paddle_tpu.models import generation as gen
 from paddle_tpu.models import llama_functional as lf
 from paddle_tpu.serving.block_manager import NULL_PAGE, BlockAllocator
 from paddle_tpu.serving.engine import Engine, Request
+from paddle_tpu.serving.sampler import pick as _pick
 from paddle_tpu.serving.scheduler import bucket_for, pages_for
+from paddle_tpu.serving.spec_decode import SpecDecoder
 
 __all__ = ["PagedEngine"]
 
 
 def _paged_prefill_traced(params, ids, h, last_idx, bt_row, new_pages,
-                          pk, pv, cos, sin, *, args, metrics, page_size,
-                          pages_per_slot):
-    """Prefill a request whose first `h` positions are already cached:
-    gather the slot's pages into a contiguous scratch stripe, forward the
-    SUFFIX tokens at position h, scatter the freshly written pages back.
+                          pk, pv, cos, sin, temp, top_p, top_k, seeds, *,
+                          args, metrics, page_size, pages_per_slot,
+                          sample=False, tp_axis=None, tp_degree=1):
+    """Prefill a suffix window whose first `h` positions are already
+    cached: gather the slot's pages into a contiguous scratch stripe,
+    forward the window tokens at position h, scatter the freshly written
+    pages back.
 
-    ids: [1, sb] suffix right-padded to a length bucket; h: traced token
-    count covered by prefix hits (a page multiple); last_idx: index of the
-    prompt's true last token WITHIN the suffix block (n - 1 - h);
-    bt_row/new_pages: [P] page indices (unused entries -> null page 0).
-    One XLA program per suffix bucket — h, last_idx and the page vectors
-    are traced operands, so hit depth never recompiles."""
+    ids: [1, sb] window right-padded to a length bucket; h: traced token
+    count already cached (prefix hits AND previously prefilled chunks —
+    always a page multiple); last_idx: index of the window's last real
+    token WITHIN the block; bt_row/new_pages: [P] page indices (unused
+    entries -> null page 0). One XLA program per window bucket — h,
+    last_idx and the page vectors are traced operands, so neither hit
+    depth nor chunk position recompiles."""
     metrics.inc("prefill_compiles")
     L, nkv, hd = pk.shape[0], pk.shape[2], pk.shape[4]
-    ps, P = page_size, pages_per_slot
+    ps, Pn = page_size, pages_per_slot
     sb = ids.shape[1]
     dtype = pk.dtype
 
@@ -82,34 +101,40 @@ def _paged_prefill_traced(params, ids, h, last_idx, bt_row, new_pages,
     # (hit pages carry real prefix K/V; later entries are garbage that the
     # suffix writes + position mask keep unread), then pad by the suffix
     # bucket so the write at [h, h+sb) can never clamp
-    g_k = jnp.swapaxes(pk[:, bt_row], 1, 2).reshape(L, 1, nkv, P * ps, hd)
-    g_v = jnp.swapaxes(pv[:, bt_row], 1, 2).reshape(L, 1, nkv, P * ps, hd)
+    g_k = jnp.swapaxes(pk[:, bt_row], 1, 2).reshape(L, 1, nkv, Pn * ps, hd)
+    g_v = jnp.swapaxes(pv[:, bt_row], 1, 2).reshape(L, 1, nkv, Pn * ps, hd)
     pad = jnp.zeros((L, 1, nkv, sb, hd), dtype)
     temp_k = jnp.concatenate([g_k, pad], axis=3)
     temp_v = jnp.concatenate([g_v, pad], axis=3)
 
     logits, temp_k, temp_v = gen._forward_cached(
-        params, ids, temp_k, temp_v, h, cos, sin, args, last_idx=last_idx)
-    first = jnp.argmax(logits, axis=-1).astype(jnp.int32)[0]
+        params, ids, temp_k, temp_v, h, cos, sin, args, last_idx=last_idx,
+        tp_axis=tp_axis, tp_degree=tp_degree)
+    # the emitted token sits at sequence index h + last_idx + 1 — the
+    # (seed, position) the offline generate(seeds=...) would use
+    first = _pick(logits, sample, temp, top_p, top_k, seeds,
+                  h + last_idx + 1)[0]
 
     # scatter the newly computed pages (suffix positions [h + i*ps, ...))
     # into the pool; unused entries land on the null page
     def chunk(t, i):
         return jax.lax.dynamic_slice_in_dim(t, h + i * ps, ps, axis=3)
 
-    new_k = jnp.concatenate([chunk(temp_k, i) for i in range(P)], axis=1)
-    new_v = jnp.concatenate([chunk(temp_v, i) for i in range(P)], axis=1)
+    new_k = jnp.concatenate([chunk(temp_k, i) for i in range(Pn)], axis=1)
+    new_v = jnp.concatenate([chunk(temp_v, i) for i in range(Pn)], axis=1)
     pk = pk.at[:, new_pages].set(new_k)   # [L, P, nkv, ps, hd]
     pv = pv.at[:, new_pages].set(new_v)
     return pk, pv, first
 
 
-def _paged_decode_traced(params, tokens, pk, pv, bt, pos, cos, sin, *,
-                         args, metrics, page_size):
+def _paged_decode_traced(params, tokens, pk, pv, bt, pos, cos, sin, temp,
+                         top_p, top_k, seeds, *, args, metrics, page_size,
+                         sample=False, tp_axis=None, tp_degree=1):
     metrics.inc("decode_compiles")
     logits, pk, pv = gen._paged_forward_decode(
-        params, tokens[:, None], pk, pv, bt, pos, cos, sin, args, page_size)
-    return pk, pv, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        params, tokens[:, None], pk, pv, bt, pos, cos, sin, args, page_size,
+        tp_axis=tp_axis, tp_degree=tp_degree)
+    return pk, pv, _pick(logits, sample, temp, top_p, top_k, seeds, pos + 1)
 
 
 def _copy_page_traced(pk, pv, src, dst):
@@ -122,7 +147,9 @@ def _copy_page_traced(pk, pv, src, dst):
 
 
 class PagedEngine(Engine):
-    """Continuous-batching engine over a paged KV cache with prefix reuse.
+    """Continuous-batching engine over a paged KV cache with prefix
+    reuse, optional tensor parallelism, chunked prefill, and speculative
+    decoding.
 
     page_size: tokens per KV page. On TPU keep it a multiple of 16 (bf16
                sublane tile) with head_dim a multiple of 128 so the Pallas
@@ -135,11 +162,25 @@ class PagedEngine(Engine):
                entire point.
     max_len:   per-REQUEST cap (block tables hold max_len/page_size
                entries); no longer a per-slot HBM reservation.
+    mesh:      optional jax Mesh carrying `tp_axis` (default 'mp'):
+               weights and the page pool shard over it and every step
+               program runs SPMD (serving/tp.py placement). num_kv_heads,
+               num_heads and intermediate_size must divide the degree.
+    prefill_chunk: optional chunk length (a multiple of page_size).
+               Prompt suffixes longer than this prefill in chunks
+               interleaved with decode steps — long prompts stop
+               stalling in-flight requests.
+    draft_params/draft_args: optional draft model (same vocab; e.g.
+               `generation.draft_from_params`) enabling speculative
+               decoding with `spec_tokens` drafts per round. Greedy
+               requests only (exact-match acceptance); sampling requests
+               are rejected at submit.
     """
 
     def __init__(self, params, args, *, max_slots=4, max_len=256,
                  page_size=16, num_pages=None, min_bucket=16, pad_id=0,
-                 metrics=None):
+                 metrics=None, mesh=None, tp_axis="mp", prefill_chunk=None,
+                 draft_params=None, draft_args=None, spec_tokens=4):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len={max_len} must be a multiple of "
@@ -148,19 +189,79 @@ class PagedEngine(Engine):
         self.pages_per_slot = int(max_len) // self.page_size
         self.num_pages = (int(num_pages) if num_pages is not None
                           else int(max_slots) * self.pages_per_slot + 1)
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk < 1 or prefill_chunk % self.page_size:
+                raise ValueError(
+                    f"prefill_chunk={prefill_chunk} must be a positive "
+                    f"multiple of page_size={page_size}")
+        self.prefill_chunk = prefill_chunk
+        if draft_params is not None and draft_args is None:
+            raise ValueError("draft_params requires draft_args "
+                             "(see generation.draft_from_params)")
+        self.draft_params = draft_params
+        self.draft_args = draft_args
+        self.spec_tokens = int(spec_tokens)
+        if draft_params is not None:
+            if self.spec_tokens < 1:
+                raise ValueError("spec_tokens must be >= 1")
+            if draft_args.vocab_size != args.vocab_size:
+                raise ValueError("draft and target must share a vocab")
         super().__init__(params, args, max_slots=max_slots, max_len=max_len,
                          min_bucket=min_bucket, pad_id=pad_id,
                          metrics=metrics)
 
+    @property
+    def spec_enabled(self):
+        return self.draft_params is not None
+
+    # -- program construction ----------------------------------------------
+    def _sharded(self, body, in_specs, out_specs, donate):
+        """jit a traced step body, shard_map-wrapped when a mesh is set.
+        check_vma stays off for these forward-only programs: the legacy
+        checker's value is guarding AD transposes, and serving has no
+        gradients — while its missing rules for scatter/sort/PRNG
+        primitives would reject valid inference bodies."""
+        if self.mesh is None:
+            return jax.jit(body, donate_argnums=donate)
+        from paddle_tpu.distributed.mesh_utils import shard_map_compat
+
+        sm = shard_map_compat(body, self.mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+        return jax.jit(sm, donate_argnums=donate)
+
     def _setup_device_state(self):
         args = self.args
+        axis = self.tp_axis
+        if self.mesh is not None:
+            from paddle_tpu.serving import tp as tp_lib
+
+            self.tp_degree = int(self.mesh.shape[axis])
+            tp_lib.tp_validate(args, self.tp_degree)
+            # eager placement: weights land in their Megatron shards once,
+            # at construction — never resharded on the hot path
+            self.params = tp_lib.shard_params(self.params, self.mesh, axis)
+            self._pspecs = tp_lib.llama_tp_specs(self.params, axis)
+            self._poolspec = tp_lib.pool_spec(axis)
+        else:
+            self.tp_degree = 1
+            self._pspecs = self._poolspec = None
+        tp_kw = dict(tp_axis=axis if self.mesh is not None else None,
+                     tp_degree=self.tp_degree)
+
         L = lf.stack_leading_dim(self.params["layers"])
         hd = args.hidden_size // args.num_heads
-        dtype = self.params["embedding"].dtype
+        dtype = jax.tree_util.tree_leaves(self.params["embedding"])[0].dtype
         self._pk = jnp.zeros(
             (L, self.num_pages, args.num_kv_heads, self.page_size, hd),
             dtype)
         self._pv = jnp.zeros_like(self._pk)
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, self._poolspec)
+            self._pk = jax.device_put(self._pk, sh)
+            self._pv = jax.device_put(self._pv, sh)
         # 2*max_len: suffix prefills write at [h, h+bucket), which can
         # overshoot max_len before masking trims it
         self._cos, self._sin = lf.rope_tables(2 * self.max_len, hd,
@@ -171,26 +272,55 @@ class PagedEngine(Engine):
         self._bt = [[] for _ in range(self.max_slots)]   # host block tables
         self._resv = {}            # slot -> pages still reserved for decode
         self._reserved_total = 0
+        self._chunk_streams = {}   # slot -> {req, n, done} mid-chunked-prefill
+        self._chunk_turn = False
+        self._admit_idx = None     # _can_prefill's cached admission scan
 
         donate = jax.default_backend() == "tpu"
-        self._prefill = jax.jit(
-            functools.partial(_paged_prefill_traced, args=args,
-                              metrics=self.metrics,
-                              page_size=self.page_size,
-                              pages_per_slot=self.pages_per_slot),
-            donate_argnums=(6, 7) if donate else ())
-        self._decode = jax.jit(
-            functools.partial(_paged_decode_traced, args=args,
-                              metrics=self.metrics,
-                              page_size=self.page_size),
-            donate_argnums=(2, 3) if donate else ())
-        self._copy_page = jax.jit(
-            _copy_page_traced, donate_argnums=(0, 1) if donate else ())
+        rep = P()
+        prefill_specs = dict(
+            in_specs=(self._pspecs, rep, rep, rep, rep, rep,
+                      self._poolspec, self._poolspec, rep, rep, rep, rep,
+                      rep, rep),
+            out_specs=(self._poolspec, self._poolspec, rep))
+        decode_specs = dict(
+            in_specs=(self._pspecs, rep, self._poolspec, self._poolspec,
+                      rep, rep, rep, rep, rep, rep, rep, rep),
+            out_specs=(self._poolspec, self._poolspec, rep))
+        self._prefill_v, self._decode_v = {}, {}
+        for sample in (False, True):
+            self._prefill_v[sample] = self._sharded(
+                functools.partial(
+                    _paged_prefill_traced, args=args, metrics=self.metrics,
+                    page_size=self.page_size,
+                    pages_per_slot=self.pages_per_slot, sample=sample,
+                    **tp_kw),
+                donate=(6, 7) if donate else (), **prefill_specs)
+            self._decode_v[sample] = self._sharded(
+                functools.partial(
+                    _paged_decode_traced, args=args, metrics=self.metrics,
+                    page_size=self.page_size, sample=sample, **tp_kw),
+                donate=(2, 3) if donate else (), **decode_specs)
+        self._copy_page = self._sharded(
+            _copy_page_traced,
+            in_specs=(self._poolspec, self._poolspec, rep, rep),
+            out_specs=(self._poolspec, self._poolspec),
+            donate=(0, 1) if donate else ())
+
+        # the speculative half (draft cache/programs + the sharded verify
+        # program + the propose/verify/accept/roll-back round) lives in
+        # serving/spec_decode.py
+        self._spec = SpecDecoder(self, donate) if self.spec_enabled else None
 
     # -- admission ----------------------------------------------------------
     def submit(self, req):
         if not isinstance(req, Request):
             req = Request(req)
+        if self.spec_enabled and req.temperature > 0:
+            raise ValueError(
+                "speculative decoding serves greedy requests only "
+                "(exact-match acceptance); submit with temperature=0 or "
+                "build the engine without draft_params")
         need = pages_for(req.prompt_ids.size, req.max_new_tokens,
                          self.page_size)
         if need > self._alloc.capacity:
@@ -200,10 +330,48 @@ class PagedEngine(Engine):
                 f"page_size={self.page_size})")
         return super().submit(req)
 
+    def _peek_hits(self, req):
+        """Side-effect-free prefix-hit count for a queued request,
+        memoized on the allocator's prefix_version: the anti-convoy scan
+        below runs every step while a chunk stream is active, and
+        re-hashing every queued prompt each step is O(queue x prompt_len)
+        host work for an answer that only changes when the prefix table
+        does."""
+        ver = self._alloc.prefix_version
+        cached = getattr(req, "_hits_memo", None)
+        if cached is not None and cached[0] == ver:
+            return cached[1]
+        hits = len(self._alloc.match_prefix(req.prompt_ids, commit=False))
+        req._hits_memo = (ver, hits)
+        return hits
+
+    def _admission_index(self):
+        """Queue index to admit next. FIFO — except while a chunk stream
+        is in flight, when the first SHORT prompt (suffix fits in one
+        chunk) bypasses queued longs: a long prefill already streaming
+        must not convoy every cheap prefill behind the NEXT long. Longs
+        keep FIFO order among themselves, and the bypass only exists
+        while a stream is active, so they cannot starve."""
+        if not self.queue:
+            return None
+        if not (self.prefill_chunk and self._chunk_streams):
+            return 0
+        for i in range(len(self.queue)):
+            req = self.queue.peek_at(i)
+            if (req.prompt_ids.size - self._peek_hits(req) * self.page_size
+                    <= self.prefill_chunk):
+                return i
+        return 0
+
     def _can_prefill(self):
+        self._admit_idx = None
         if not (self.queue and self.slots.free_count):
             return False
-        req = self.queue.peek()
+        # cache the scan for the _prefill_step that immediately follows a
+        # True answer — the anti-convoy walk match_prefix-hashes every
+        # queued prompt, which is too much host work to repeat per step
+        self._admit_idx = self._admission_index()
+        req = self.queue.peek_at(self._admit_idx)
         hits = self._alloc.match_prefix(req.prompt_ids, commit=False)
         # reviving a cached (refcount-0) hit consumes availability just
         # like a fresh alloc; an actively shared hit is free
@@ -212,68 +380,211 @@ class PagedEngine(Engine):
                           self.page_size) - len(hits) + revive)
         return need <= self._alloc.available - self._reserved_total
 
+    # -- the interleaving scheduler -----------------------------------------
+    def _step_action(self):
+        """Chunked-prefill interleave: while a prompt is mid-stream, the
+        engine alternates one chunk with one unit of other work (admit a
+        waiting request or run a decode/speculation step), so queued and
+        in-flight requests keep making progress underneath a long
+        prefill. Decode becomes speculate-and-verify when a draft model
+        is loaded."""
+        if self._chunk_streams and self._chunk_turn:
+            self._chunk_turn = False
+            self._note_prefill_stall()
+            return self._chunk_step()
+        if self._can_prefill():
+            self._chunk_turn = True
+            self._note_prefill_stall()
+            return self._prefill_step()
+        if self._decodable_slots():
+            self._chunk_turn = True
+            if self.spec_enabled:
+                return self._spec.step()
+            return self._decode_step()
+        if self._chunk_streams:
+            return self._chunk_step()
+        return {"type": "idle"}
+
+    def _decodable_slots(self):
+        active = self.slots.active_slots
+        if not self._chunk_streams:
+            return active
+        return [s for s in active if s not in self._chunk_streams]
+
     # -- prefill ------------------------------------------------------------
-    def _prefill_device(self, req, slot, n):
-        ps, P = self.page_size, self.pages_per_slot
+    def _begin_paged_prefill(self, req, slot, n):
+        """Match prefix hits, seat the block table, and reserve the
+        request's remaining worst-case pages (prompt pages still to be
+        written draw from this reservation chunk by chunk; the decode
+        tail draws from it at page boundaries). Returns h — the cached
+        token count the first window starts at."""
+        ps = self.page_size
         hits = self._alloc.match_prefix(req.prompt_ids)   # refs hit pages
         h = len(hits) * ps
-        n_now = -(-n // ps) - len(hits)                   # pages to write
-        new_pages = [self._alloc.alloc() for _ in range(n_now)]
-        pages = hits + new_pages
-        resv = pages_for(n, req.max_new_tokens, ps) - len(pages)
+        self._bt[slot] = list(hits)
+        resv = pages_for(n, req.max_new_tokens, ps) - len(hits)
         self._resv[slot] = resv
         self._reserved_total += resv
-        self._bt[slot] = pages
-
-        bt_row = np.zeros(P, np.int32)
-        bt_row[:len(pages)] = pages
-        new_vec = np.full(P, NULL_PAGE, np.int32)
-        new_vec[:n_now] = new_pages
-        sb = bucket_for(n - h, self.min_bucket, self.max_len)
-        padded = np.full((1, sb), self.pad_id, np.int32)
-        padded[0, :n - h] = req.prompt_ids[h:]
-        with self.metrics.timer("prefill_s"):
-            self._pk, self._pv, first = self._prefill(
-                self.params, jnp.asarray(padded), jnp.int32(h),
-                jnp.int32(n - 1 - h), jnp.asarray(bt_row),
-                jnp.asarray(new_vec), self._pk, self._pv,
-                self._cos, self._sin)
-            first = int(first)
-        # make this prompt's full pages hittable for future requests
-        self._alloc.register_prefix(req.prompt_ids, pages[:n // ps])
         self.metrics.inc("prompt_tokens", n)
         self.metrics.inc("prefix_tokens_hit", h)
         self.metrics.inc("prefix_pages_hit", len(hits))
         self.metrics.inc("prefix_pages_queried", (n - 1) // ps)
+        return h
+
+    def _window_prefill_device(self, req, slot, start, end, n):
+        """Run one prefill window [start, end) of the prompt (the whole
+        suffix, or one chunk of it) through the suffix program. Returns
+        (bucket, token) — the token is meaningful only for the final
+        window (end == n), which also registers the prompt's full pages
+        in the prefix cache."""
+        ps, Pn = self.page_size, self.pages_per_slot
+        final = end == n
+        n_now = -(-end // ps) - start // ps           # pages this window
+        new_pages = [self._alloc.alloc() for _ in range(n_now)]
+        self._resv[slot] -= n_now
+        self._reserved_total -= n_now
+        self._bt[slot].extend(new_pages)
+        pages = self._bt[slot]
+
+        bt_row = np.zeros(Pn, np.int32)
+        bt_row[:len(pages)] = pages
+        new_vec = np.full(Pn, NULL_PAGE, np.int32)
+        new_vec[:n_now] = new_pages
+        sb = bucket_for(end - start, self.min_bucket, self.max_len)
+        padded = np.full((1, sb), self.pad_id, np.int32)
+        padded[0, :end - start] = req.prompt_ids[start:end]
+        sample = final and req.temperature > 0
+        with self.metrics.timer("prefill_s"):
+            self._pk, self._pv, first = self._prefill_v[sample](
+                self.params, jnp.asarray(padded), jnp.int32(start),
+                jnp.int32(end - 1 - start), jnp.asarray(bt_row),
+                jnp.asarray(new_vec), self._pk, self._pv,
+                self._cos, self._sin, jnp.float32(req.temperature),
+                jnp.float32(req.top_p), jnp.int32(req.top_k),
+                jnp.asarray([req.seed], jnp.int32))
+            first = int(first)
+        if final:
+            # make this prompt's full pages hittable for future requests
+            self._alloc.register_prefix(req.prompt_ids, pages[:n // ps])
+            # chunk-streamed prompts mirror into the draft window by
+            # window instead (see _chunk_step) — one monolithic draft
+            # prefill here would reintroduce the stall chunking removes
+            if self.spec_enabled and slot not in self._chunk_streams:
+                self._spec.prefill_slot(req, slot, n)
         return sb, first
 
+    def _prefill_device(self, req, slot, n):
+        """Monolithic prefill (no chunking, or suffix within one chunk)."""
+        h = self._begin_paged_prefill(req, slot, n)
+        return self._window_prefill_device(req, slot, h, n, n)
+
+    def _prefill_step(self):
+        """Admit the queue head; suffixes longer than `prefill_chunk`
+        become a chunk STREAM advanced by later steps instead of one
+        monolithic program."""
+        if self.prefill_chunk is None:
+            return super()._prefill_step()
+        idx = self._admit_idx if self._admit_idx is not None \
+            else self._admission_index()
+        req = self.queue.pop_at(idx)
+        slot = self._admit(req)
+        n = int(req.prompt_ids.size)
+        h = self._begin_paged_prefill(req, slot, n)
+        if n - h <= self.prefill_chunk:
+            bucket, first = self._window_prefill_device(req, slot, h, n, n)
+            self.metrics.observe("chunks_per_prompt", 1)
+            return self._complete_prefill(req, slot, bucket, first, n)
+        self._chunk_streams[slot] = {"req": req, "n": n, "done": h,
+                                     "ddone": 0, "chunks": 0,
+                                     "bucket": None, "first": None}
+        self.metrics.inc("chunked_prefills")
+        return self._chunk_step()
+
+    def _chunk_step(self):
+        """Advance the oldest chunk stream (FIFO: the first admitted long
+        prompt finishes first) by ONE bounded unit of prefill work: a
+        target chunk, or — when speculation is on and the draft's mirror
+        of the prompt lags the target's progress — one draft window of
+        the same size, so the draft prefill never runs monolithically
+        inside a single scheduler step."""
+        slot = next(iter(self._chunk_streams))
+        st = self._chunk_streams[slot]
+        req, n = st["req"], st["n"]
+        if self.spec_enabled and st["ddone"] < n and \
+                (st["ddone"] < st["done"] or st["done"] == n):
+            dstart = st["ddone"]
+            dend = min(dstart + self.prefill_chunk, n)
+            self._spec.prefill_window(req, slot, dstart, dend)
+            st["ddone"] = dend
+            self.metrics.inc("draft_prefill_chunks")
+            if dend < n or st["done"] < n:
+                return {"type": "draft_prefill_chunk",
+                        "request_id": req.request_id, "slot": slot,
+                        "from": dstart, "to": dend}
+            return self._finish_stream(slot, st)
+        start = st["done"]
+        end = min(start + self.prefill_chunk, n)
+        bucket, first = self._window_prefill_device(req, slot, start, end, n)
+        st["done"] = end
+        st["chunks"] += 1
+        self.metrics.inc("prefill_chunks")
+        self.metrics.inc("prefill_chunk_tokens", end - start)
+        if end == n:
+            st["bucket"], st["first"] = bucket, first
+            # the TARGET's prompt KV is complete here; the first token is
+            # only emitted at _finish_stream, which may wait whole steps
+            # for the draft mirror — the prefill_done_s / ttft_s split
+            self._record_prefill_done(req)
+            if not (self.spec_enabled and st["ddone"] < n):
+                return self._finish_stream(slot, st)
+        return {"type": "prefill_chunk", "request_id": req.request_id,
+                "slot": slot, "from": start, "to": end}
+
+    def _finish_stream(self, slot, st):
+        """Both the target chunks and (under speculation) the draft
+        mirror are complete: retire the stream and emit the stashed
+        first token."""
+        del self._chunk_streams[slot]
+        self.metrics.observe("chunks_per_prompt", st["chunks"])
+        return self._complete_prefill(st["req"], slot, st["bucket"],
+                                      st["first"], st["n"])
+
     # -- decode -------------------------------------------------------------
+    def _ensure_tail_pages(self, slot, top):
+        """Make the slot's KV positions [npos, top] writable: COW the
+        current tail page if it is shared or hash-registered, then draw
+        page-boundary allocations from the slot's admission-time
+        reservation through `top`. The ONE home of the tail-page
+        invariants — plain decode (top == npos) and the speculative
+        verify window (top == min(npos + g, limit)) both call it."""
+        ps = self.page_size
+        pages = self._bt[slot]
+        pi = int(self._npos[slot]) // ps
+        if pi < len(pages):
+            old = pages[pi]
+            page, copied = self._alloc.ensure_writable(old)
+            if copied:
+                self._pk, self._pv = self._copy_page(
+                    self._pk, self._pv, jnp.int32(old), jnp.int32(page))
+                pages[pi] = page
+        while len(pages) * ps <= top:
+            pages.append(self._alloc.alloc())
+            self._resv[slot] -= 1
+            self._reserved_total -= 1
+
     def _decode_device(self, active):
-        ps, P = self.page_size, self.pages_per_slot
+        Pn = self.pages_per_slot
         for slot in active:
-            pi = int(self._npos[slot]) // ps
-            pages = self._bt[slot]
-            if pi == len(pages):
-                # crossing a page boundary: draw the tail page from this
-                # slot's admission-time reservation
-                pages.append(self._alloc.alloc())
-                self._resv[slot] -= 1
-                self._reserved_total -= 1
-            else:
-                old = pages[pi]
-                page, copied = self._alloc.ensure_writable(old)
-                if copied:
-                    self._pk, self._pv = self._copy_page(
-                        self._pk, self._pv, jnp.int32(old), jnp.int32(page))
-                    pages[pi] = page
-        bt = np.full((self.max_slots, P), NULL_PAGE, np.int32)
+            self._ensure_tail_pages(slot, int(self._npos[slot]))
+        bt = np.full((self.max_slots, Pn), NULL_PAGE, np.int32)
         for slot in active:
             bt[slot, :len(self._bt[slot])] = self._bt[slot]
         with self.metrics.timer("decode_step_s"):
-            self._pk, self._pv, nxt = self._decode(
+            self._pk, self._pv, nxt = self._decode_v[
+                self._sampling_active()](
                 self.params, jnp.asarray(self._last_tok), self._pk,
                 self._pv, jnp.asarray(bt), jnp.asarray(self._npos),
-                self._cos, self._sin)
+                self._cos, self._sin, *self._sampling_args())
         return np.asarray(nxt)
 
     # -- lifecycle ----------------------------------------------------------
@@ -282,6 +593,8 @@ class PagedEngine(Engine):
             self._alloc.release(p)
         self._bt[slot] = []
         self._reserved_total -= self._resv.pop(slot, 0)
+        if self.spec_enabled:
+            self._spec.retire(slot)
         super()._retire(slot)
 
     def reset(self):
@@ -294,3 +607,7 @@ class PagedEngine(Engine):
         self._bt = [[] for _ in range(self.max_slots)]
         self._resv = {}
         self._reserved_total = 0
+        self._chunk_streams = {}
+        self._chunk_turn = False
+        if self.spec_enabled:
+            self._spec.reset()

@@ -1,0 +1,359 @@
+"""Draft-model speculative decoding over the paged serving engine.
+
+Leviathan-style greedy speculation (Leviathan et al. 2023), organized so
+the whole round is two device dispatches regardless of the draft length:
+
+  PROPOSE — a cheap draft model (e.g. `generation.draft_from_params`
+  truncation) runs `spec_tokens` greedy decode steps over its OWN stripe
+  cache in ONE traced scan. Step j of row r feeds the committed tokens
+  the draft hasn't ingested yet (forced-feed catch-up — after a fully
+  accepted round the draft is one token behind the target) and its own
+  previous output after that.
+
+  VERIFY — the target model scores the whole window [last committed
+  token, draft_1..draft_g] in ONE batched paged forward
+  (`generation._paged_forward_verify`): token i of row r at position
+  pos[r]+i, K/V scattered into the row's tail pages write-before-attend,
+  writes past the row's page reservation redirected to the null page.
+
+  ACCEPT — the host commits the longest exactly-matching prefix plus the
+  target's own next token: between 1 and g+1 tokens per round, every one
+  of them exactly the target's greedy sequence (speculation changes the
+  schedule, never the output).
+
+  ROLL BACK — rejected tail tokens are erased by truncating the
+  watermark (`_npos`) and the BLOCK TABLE: tail pages allocated for the
+  window that end up wholly past the new watermark are released back to
+  the pool and their reservation refunded, so after a worst-case
+  all-rejected round the block table and page refcounts are bit-identical
+  to a plain decode step's (tested). The partially-filled tail page keeps
+  its rejected K/V as garbage — the write-before-attend order overwrites
+  it before the position mask ever exposes it. Shared/registered tail
+  pages are COW'd before the window writes, exactly as plain decode.
+
+The draft stays REPLICATED under a tensor-parallel mesh (its whole point
+is being cheap); only the target-side verify shards.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.models import generation as gen
+from paddle_tpu.models import llama_functional as lf
+from paddle_tpu.serving.block_manager import NULL_PAGE
+from paddle_tpu.serving.scheduler import bucket_for
+
+__all__ = ["SpecDecoder"]
+
+
+def _paged_verify_traced(params, ids, pk, pv, bt, pos, limit, cos, sin, *,
+                         args, metrics, page_size, tp_axis=None,
+                         tp_degree=1):
+    """Target-model half of a speculation round: score the whole draft
+    window [b, g+1] in one forward (token i of row r at position
+    pos[r]+i), writing its K/V into the tail pages (positions past
+    limit[r] go to the null page). Returns the target's greedy token at
+    every window position — the host accepts the longest exact match."""
+    metrics.inc("verify_compiles")
+    logits, pk, pv = gen._paged_forward_verify(
+        params, ids, pk, pv, bt, pos, limit, cos, sin, args, page_size,
+        tp_axis=tp_axis, tp_degree=tp_degree)
+    return pk, pv, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _draft_window_traced(params, ids, h, ck, cv, slot, cos, sin, *, args,
+                         metrics):
+    """One prefill WINDOW of the draft's stripe cache: forward ids
+    [1, sb] at traced offset h, writing KV slots [h, h+sb) of `slot`'s
+    stripe (earlier windows' KV below h is already in place — the same
+    suffix-at-a-deeper-h trick the target's chunked prefill uses, minus
+    the prefix cache: the draft has none, so its windows start at 0).
+    Logits are discarded — the draft only needs the KV."""
+    metrics.inc("draft_prefill_compiles")
+    sb = ids.shape[1]
+    max_len = ck.shape[3]
+    sck = jax.lax.dynamic_slice_in_dim(ck, slot, 1, axis=1)
+    scv = jax.lax.dynamic_slice_in_dim(cv, slot, 1, axis=1)
+    # pad the scratch stripe by the bucket so the write at [h, h+sb) can
+    # never clamp (the overshoot trick the target's suffix prefill uses);
+    # the pad tail is sliced off before writing back
+    pad = jnp.zeros(sck.shape[:3] + (sb,) + sck.shape[4:], sck.dtype)
+    tk = jnp.concatenate([sck, pad], axis=3)
+    tv = jnp.concatenate([scv, pad], axis=3)
+    _, tk, tv = gen._forward_cached(params, ids, tk, tv, h, cos, sin,
+                                    args, last_idx=0)
+    ck = jax.lax.dynamic_update_slice_in_dim(
+        ck, jax.lax.slice_in_dim(tk, 0, max_len, axis=3), slot, axis=1)
+    cv = jax.lax.dynamic_update_slice_in_dim(
+        cv, jax.lax.slice_in_dim(tv, 0, max_len, axis=3), slot, axis=1)
+    return ck, cv
+
+
+def _draft_propose_traced(params, forced, n_forced, start, ck, cv, cos,
+                          sin, *, args, metrics, steps):
+    """Draft-model propose: `steps` greedy decode steps over the draft's
+    stripe cache in ONE traced scan (one device dispatch per round, not
+    per token). Step j of row r feeds forced[r, j] while j < n_forced[r]
+    — the committed tokens the draft hasn't ingested yet (its own last
+    token, plus one catch-up token after a fully-accepted round) — and
+    its own previous output after that, at position start[r] + j."""
+    metrics.inc("draft_propose_compiles")
+
+    def stepf(carry, xs):
+        prev, ck, cv = carry
+        j, forced_j = xs
+        tok = jnp.where(j < n_forced, forced_j, prev)
+        logits, ck, cv = gen._forward_cached(
+            params, tok[:, None], ck, cv, start + j, cos, sin, args)
+        out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (out, ck, cv), out
+
+    (_, ck, cv), outs = jax.lax.scan(
+        stepf, (forced[:, 0], ck, cv),
+        (jnp.arange(steps, dtype=jnp.int32), jnp.swapaxes(forced, 0, 1)))
+    return ck, cv, jnp.swapaxes(outs, 0, 1)    # [S, steps]
+
+
+class SpecDecoder:
+    """The speculative half of a `PagedEngine`: owns the draft model's
+    stripe cache + programs and the target's sharded verify program, and
+    runs the propose → verify → accept → roll-back round. Mutates the
+    engine's block tables / positions / reservations in place — it IS
+    the engine's decode step while a draft model is loaded."""
+
+    def __init__(self, engine, donate):
+        from paddle_tpu.serving.engine import _prefill_traced
+
+        self.eng = engine
+        self.g = engine.spec_tokens
+        dargs = engine.draft_args
+        self.draft_params = engine.draft_params
+        self.draft_args = dargs
+        Ld = lf.stack_leading_dim(self.draft_params["layers"])
+        dhd = dargs.hidden_size // dargs.num_heads
+        ddtype = self.draft_params["embedding"].dtype
+        self._dck = jnp.zeros(
+            (Ld, engine.max_slots, dargs.num_kv_heads, engine.max_len,
+             dhd), ddtype)
+        self._dcv = jnp.zeros_like(self._dck)
+        # 2*max_len tables: window prefills forward a bucket at offset h,
+        # and h+bucket can overshoot max_len before masking trims it (the
+        # same overshoot the target's suffix prefill pads for)
+        self._dcos, self._dsin = lf.rope_tables(2 * engine.max_len, dhd,
+                                                dargs.rope_theta)
+        self._dpos = np.zeros(engine.max_slots, np.int32)
+        self._draft_prefill = jax.jit(
+            functools.partial(_prefill_traced, args=dargs,
+                              metrics=engine.metrics,
+                              counter="draft_prefill_compiles"),
+            donate_argnums=(3, 4) if donate else (),
+            static_argnames=("sample",))
+        self._draft_window = jax.jit(
+            functools.partial(_draft_window_traced, args=dargs,
+                              metrics=engine.metrics),
+            donate_argnums=(3, 4) if donate else ())
+        # g+1 draft steps, not g: after a fully-accepted round the draft
+        # is one token behind the target (lag 1), and the extra step keeps
+        # every verify column backed by a FRESH proposal — lag then
+        # stabilizes at <= 1 instead of climbing on repetitive text while
+        # clamped duplicate drafts keep matching
+        self._draft_propose = jax.jit(
+            functools.partial(_draft_propose_traced, args=dargs,
+                              metrics=engine.metrics, steps=self.g + 1),
+            donate_argnums=(4, 5) if donate else ())
+        rep = P()
+        self._verify = engine._sharded(
+            functools.partial(
+                _paged_verify_traced, args=engine.args,
+                metrics=engine.metrics, page_size=engine.page_size,
+                tp_axis=engine.tp_axis if engine.mesh is not None else None,
+                tp_degree=engine.tp_degree),
+            in_specs=(engine._pspecs, rep, engine._poolspec,
+                      engine._poolspec, rep, rep, rep, rep, rep),
+            out_specs=(engine._poolspec, engine._poolspec, rep),
+            donate=(2, 3) if donate else ())
+
+    # -- lifecycle -----------------------------------------------------------
+    def prefill_slot(self, req, slot, n):
+        """Mirror the finished prompt into the draft's stripe cache."""
+        eng = self.eng
+        bucket = bucket_for(n, eng.min_bucket, eng.max_len)
+        padded = np.full((1, bucket), eng.pad_id, np.int32)
+        padded[0, :n] = req.prompt_ids
+        with eng.metrics.timer("draft_prefill_s"):
+            self._dck, self._dcv, _ = self._draft_prefill(
+                self.draft_params, jnp.asarray(padded), jnp.int32(n),
+                self._dck, self._dcv, jnp.int32(slot), self._dcos,
+                self._dsin, jnp.float32(0.0), jnp.float32(1.0),
+                jnp.int32(0), jnp.asarray([0], jnp.int32), sample=False)
+        self._dpos[slot] = n
+
+    def prefill_window(self, req, slot, start, end):
+        """Advance the draft's mirror of a chunk-streamed prompt by one
+        window [start, end) — the draft prefill rides the same bounded
+        scheduler steps as the target's chunks instead of running the
+        whole prompt monolithically at the final chunk (which would
+        reintroduce exactly the stall chunking removes). Windows start
+        at 0: the draft has no prefix cache."""
+        eng = self.eng
+        n = int(req.prompt_ids.size)
+        sb = bucket_for(end - start, eng.min_bucket, eng.max_len)
+        padded = np.full((1, sb), eng.pad_id, np.int32)
+        padded[0, :end - start] = req.prompt_ids[start:end]
+        with eng.metrics.timer("draft_prefill_s"):
+            self._dck, self._dcv = self._draft_window(
+                self.draft_params, jnp.asarray(padded), jnp.int32(start),
+                self._dck, self._dcv, jnp.int32(slot), self._dcos,
+                self._dsin)
+        # track the mirror frontier as windows land (not just at end == n):
+        # speculation rounds for OTHER slots run the propose scan over all
+        # S rows, and a row's scan writes land at _dpos[row] — pointing a
+        # mid-stream row's writes at its frontier keeps them on positions
+        # the next window rewrites anyway, instead of clobbering the
+        # already-mirrored prefix at 0
+        self._dpos[slot] = end
+
+    def retire(self, slot):
+        self._dpos[slot] = 0
+
+    def reset(self):
+        self._dpos[:] = 0
+
+    # -- the round -----------------------------------------------------------
+    def _seq_token(self, req, idx):
+        """Committed token at sequence index idx (prompt, then outputs)."""
+        n = req.prompt_ids.size
+        return int(req.prompt_ids[idx]) if idx < n \
+            else int(req.token_ids[idx - n])
+
+    def _limit(self, slot):
+        """A row's last legal KV write index — the top of its
+        admission-time page reservation (`scheduler.pages_for`)."""
+        req = self.eng.slots.owner(slot)
+        return int(req.prompt_ids.size) + req.max_new_tokens - 2
+
+    def _propose_device(self, forced, n_forced, start):
+        """One draft-scan dispatch (separate method so tests can stub an
+        adversarial draft)."""
+        with self.eng.metrics.timer("draft_propose_s"):
+            self._dck, self._dcv, outs = self._draft_propose(
+                self.draft_params, jnp.asarray(forced),
+                jnp.asarray(n_forced), jnp.asarray(start), self._dck,
+                self._dcv, self._dcos, self._dsin)
+        return np.asarray(outs)                           # [S, g]
+
+    def step(self):
+        """One speculation round: draft proposes g tokens (one traced
+        scan), the target verifies the whole window (one batched paged
+        forward), the host commits the longest exactly-matching prefix
+        plus the target's next token — between 1 and g+1 tokens per
+        round, all of them exactly the target's greedy sequence — then
+        rolls the block table back to the new watermark."""
+        eng = self.eng
+        active = eng._decodable_slots()
+        S, g = eng.max_slots, self.g
+        steps = g + 1
+        Pn = eng.pages_per_slot
+
+        # ---- propose -----------------------------------------------------
+        # the scan runs over ALL S rows; non-active rows (free, or a
+        # prompt mid-chunked-prefill) still get pad-fed writes at
+        # start[r] + j, so start MUST be each row's own frontier (_dpos):
+        # writes then hit positions later windows / decode steps rewrite,
+        # never the valid mirrored prefix below the frontier
+        forced = np.zeros((S, steps), np.int32)
+        n_forced = np.ones(S, np.int32)
+        start = np.asarray(self._dpos, np.int32).copy()
+        lag = {}
+        for slot in active:
+            req = eng.slots.owner(slot)
+            lag[slot] = int(eng._npos[slot]) - int(self._dpos[slot])
+            start[slot] = self._dpos[slot]
+            n_forced[slot] = lag[slot] + 1
+            for j in range(min(lag[slot] + 1, steps)):
+                forced[slot, j] = self._seq_token(
+                    req, int(self._dpos[slot]) + j)
+        outs = self._propose_device(forced, n_forced, start)
+
+        # ---- tail pages for the verify window ----------------------------
+        limit = np.full(S, -1, np.int32)
+        for slot in active:
+            limit[slot] = self._limit(slot)
+            eng._ensure_tail_pages(
+                slot, min(int(eng._npos[slot]) + g, int(limit[slot])))
+
+        # ---- verify ------------------------------------------------------
+        ids = np.full((S, g + 1), eng.pad_id, np.int32)
+        for slot in active:
+            ids[slot, 0] = eng._last_tok[slot]
+            for i in range(1, g + 1):
+                j = lag[slot] + i - 1            # draft for index npos+i
+                # lag <= 1 keeps j within the proposals (defensive clamp
+                # against an adversarial/stubbed shorter propose)
+                ids[slot, i] = outs[slot, min(j, outs.shape[1] - 1)]
+        bt = np.full((S, Pn), NULL_PAGE, np.int32)
+        for slot in active:
+            bt[slot, :len(eng._bt[slot])] = eng._bt[slot]
+        with eng.metrics.timer("verify_s"):
+            eng._pk, eng._pv, tgt = self._verify(
+                eng.params, jnp.asarray(ids), eng._pk, eng._pv,
+                jnp.asarray(bt), jnp.asarray(eng._npos),
+                jnp.asarray(limit), eng._cos, eng._sin)
+            tgt = np.asarray(tgt)                         # [S, g+1]
+
+        # ---- accept + roll back ------------------------------------------
+        emitted = {}
+        for slot in active:
+            req = eng.slots.owner(slot)
+            p = int(eng._npos[slot])
+            drafts = [int(ids[slot, i]) for i in range(1, g + 1)]
+            a = 0
+            while a < g and drafts[a] == int(tgt[slot, a]):
+                a += 1
+            commit = drafts[:a] + [int(tgt[slot, a])] if a < g \
+                else drafts + [int(tgt[slot, g])]
+            k = 0
+            for tok in commit:
+                eng._emit(req, tok)
+                k += 1
+                if req.finished:
+                    break
+            eng._npos[slot] = p + k
+            eng._last_tok[slot] = req.token_ids[-1]
+            self._dpos[slot] = min(int(start[slot]) + steps,
+                                   p + min(a, k) + 1, p + k)
+            emitted[req.request_id] = commit[:k]
+            eng.metrics.inc("draft_tokens_proposed", g)
+            eng.metrics.inc("draft_tokens_accepted", min(a, k))
+            eng.metrics.inc("tokens_generated", k)
+            eng.metrics.observe("spec_commit_len", k)
+            eng.metrics.observe("spec_acceptance_rate", min(a, k) / g)
+            if req.finished:
+                eng._retire(slot)
+            else:
+                self._rollback_tail(slot, p + k)
+        eng.metrics.inc("spec_rounds")
+        eng.metrics.observe("tokens_per_decode_step",
+                            sum(len(v) for v in emitted.values()))
+        return {"type": "spec_decode", "tokens": emitted}
+
+    def _rollback_tail(self, slot, npos):
+        """Truncate the slot's block table to the pages covering the
+        committed positions [0, npos): window pages wholly past the new
+        watermark return to the pool and their reservation is refunded.
+        The rejected K/V inside the kept tail page stays as garbage that
+        the next write-before-attend step overwrites."""
+        eng = self.eng
+        keep = (npos - 1) // eng.page_size + 1
+        pages = eng._bt[slot]
+        while len(pages) > keep:
+            eng._alloc.release(pages.pop())
+            eng._resv[slot] += 1
+            eng._reserved_total += 1
+            eng.metrics.inc("spec_pages_rewound")

@@ -492,6 +492,62 @@ def test_ps_server_failover_mid_training():
             assert p.returncode == 0, f"{name} failed:\n{out}"
 
 
+WORKER_SERVING = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from paddle_tpu.distributed.mesh_utils import single_axis_mesh
+    from paddle_tpu.models import llama_functional as lf
+    from paddle_tpu.models.generation import draft_from_params, generate
+    from paddle_tpu.serving import PagedEngine, Request
+
+    ARGS = lf.LlamaArgs(vocab_size=128, hidden_size=64,
+                        intermediate_size=176, num_layers=2, num_heads=4,
+                        num_kv_heads=2, rope_theta=1e4, rms_eps=1e-6,
+                        use_flash=False)
+    params = lf.init_params(ARGS, jax.random.key(0))
+    mesh = single_axis_mesh("mp", 2)
+    dp, da = draft_from_params(params, ARGS, 1)
+    eng = PagedEngine(params, ARGS, max_slots=2, max_len=64, page_size=8,
+                      min_bucket=8, mesh=mesh, prefill_chunk=16,
+                      draft_params=dp, draft_args=da, spec_tokens=3)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(1, 128, size=n).astype(np.int32)
+               for n in (3, 5, 9, 21)]
+    reqs = eng.serve([Request(p, 6) for p in prompts])
+    for p, r in zip(prompts, reqs):
+        ref = np.asarray(generate(params, ARGS, p[None],
+                                  max_new_tokens=6))[0][len(p):]
+        np.testing.assert_array_equal(np.asarray(r.token_ids), ref)
+    assert len(eng._pk.sharding.device_set) == 2, eng._pk.sharding
+    c = eng.metrics.summary()["counters"]
+    assert c["spec_rounds"] > 0 and c["chunked_prefills"] >= 1, c
+    print("SHARDED_SERVING_OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_sharded_serving_dryrun_leg():
+    """Dryrun-scale sharded serving: the paged engine over a 2-device
+    `mp` mesh (4 virtual CPU devices in a fresh subprocess so the
+    XLA device-count flag is honored), chunked prefill + speculative
+    decoding enabled, token-for-token parity with sequential generate.
+    The same leg runs in `__graft_entry__.dryrun_multichip`."""
+    port = _free_port()
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        open(script, "w").write(WORKER_SERVING)
+        p = _spawn(script, 0, 1, f"127.0.0.1:{port}")
+        out, _ = p.communicate(timeout=600)
+        assert p.returncode == 0, f"serving worker failed:\n{out}"
+        assert "SHARDED_SERVING_OK" in out
+
+
 WORKER_P2P = textwrap.dedent("""
     import os
     os.environ["JAX_PLATFORMS"] = "cpu"

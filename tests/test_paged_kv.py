@@ -423,6 +423,110 @@ class TestPagedScheduling:
         assert m["prefill_compiles"] <= 3   # suffix buckets: 8, 16, 32
 
 
+class TestSpecDecodePaged:
+    """Speculative decoding at the PAGE level: accepted draft tokens'
+    K/V must land in the slot's tail pages exactly where plain decode
+    puts them (checked through the `paged_gather` oracle — the same
+    gather that backs the kernel parity tests), and a worst-case
+    all-rejected round must roll the verify window's allocations back
+    to a state bit-identical to plain decode's."""
+
+    def _spec_engine(self, p, **kw):
+        from paddle_tpu.models.generation import draft_from_params
+
+        dp, da = draft_from_params(p, ARGS, 1)
+        return PagedEngine(p, ARGS, max_slots=2, max_len=64, page_size=8,
+                           min_bucket=8, draft_params=dp, draft_args=da,
+                           spec_tokens=3, **kw)
+
+    def test_accepted_tokens_in_tail_pages_match_paged_gather_oracle(
+            self, params):
+        """Drive a speculative and a plain engine over the same request,
+        stop mid-flight once the committed tokens have crossed a page
+        boundary, and gather each pool through its block table: every
+        committed position's K/V must agree — i.e. the batched verify
+        forward scattered accepted tokens into the freshly allocated
+        tail pages exactly as one-token-at-a-time decode would (page ids
+        may differ; the gather normalizes the mapping away)."""
+        (p,) = _prompts([12], seed=71)
+        plain = PagedEngine(params, ARGS, max_slots=2, max_len=64,
+                            page_size=8, min_bucket=8)
+        spec = self._spec_engine(params)
+        rs = spec.submit(Request(p, 40))
+        rp = plain.submit(Request(p, 40))
+        spec.step(), plain.step()                      # prefill
+        while int(spec._npos[0]) < 25 and not rs.finished:
+            spec.step()
+        while int(plain._npos[0]) < int(spec._npos[0]):
+            plain.step()
+        npos = int(spec._npos[0])
+        assert not rs.finished and npos == int(plain._npos[0])
+        assert rp.token_ids[:len(rs.token_ids)] == rs.token_ids
+        ps = spec.page_size
+        prompt_pages = -(-p.size // ps)
+        assert len(spec._bt[0]) > prompt_pages         # tail pages in use
+        assert spec.metrics.summary()["counters"]["spec_rounds"] > 0
+
+        def gathered(eng, pool):
+            bt = np.full((1, eng.pages_per_slot), NULL_PAGE, np.int32)
+            bt[0, :len(eng._bt[0])] = eng._bt[0]
+            rows = [qm.paged_gather(pool[l], jnp.asarray(bt))
+                    for l in range(pool.shape[0])]
+            return np.asarray(jnp.stack(rows))[:, 0, :, :npos]
+
+        for pool_s, pool_p in ((spec._pk, plain._pk),
+                               (spec._pv, plain._pv)):
+            got, want = gathered(spec, pool_s), gathered(plain, pool_p)
+            # tail positions really carry K/V (not zeros/null garbage)
+            assert np.abs(got[:, :, prompt_pages * ps:]).max() > 0
+            # verify writes vs single-token decode writes: same values up
+            # to reduction-order ulps (shapes differ between the two
+            # programs, so bitwise equality is not the contract)
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-6)
+
+    def test_all_rejected_round_state_matches_plain_decode(self, params):
+        """Worst-case rollback: an adversarial draft whose every token
+        the target rejects. Each round commits exactly 1 token (the
+        target's own), and after EVERY round the block tables, page
+        refcounts, free/available counts and reservations are
+        bit-identical to a plain engine decoding the same request —
+        the speculative window leaves no trace in the allocator."""
+        (p,) = _prompts([20], seed=51)
+        ref = _sequential(params, [p], max_new=10)[0]
+        used = set(ref.tolist()) | set(p.tolist())
+        bad = next(t for t in range(1, ARGS.vocab_size) if t not in used)
+
+        plain = PagedEngine(params, ARGS, max_slots=2, max_len=64,
+                            page_size=8, min_bucket=8)
+        spec = self._spec_engine(params)
+        spec._spec._propose_device = \
+            lambda forced, n_forced, start: np.full(
+                (spec.max_slots, spec.spec_tokens), bad, np.int32)
+
+        def state(eng):
+            return (tuple(tuple(row) for row in eng._bt),
+                    tuple(tuple(eng._alloc.refcount(pg) for pg in row)
+                          for row in eng._bt),
+                    eng._alloc.free_count, eng._alloc.available,
+                    dict(eng._resv), eng._reserved_total)
+
+        rp = plain.submit(Request(p, 10))
+        rs = spec.submit(Request(p, 10))
+        plain.step(), spec.step()            # prefill
+        assert state(plain) == state(spec)
+        while not rs.finished:
+            ev = spec.step()
+            assert ev["type"] == "spec_decode"
+            (committed,) = ev["tokens"].values()
+            assert len(committed) == 1       # every draft token rejected
+            plain.step()
+            assert state(plain) == state(spec)
+        assert rp.token_ids == rs.token_ids == list(ref)
+        c = spec.metrics.summary()["counters"]
+        assert c["spec_pages_rewound"] > 0   # the window did alloc pages
+        assert c["draft_tokens_accepted"] == 0
+
+
 @pytest.mark.slow
 class TestPagedSoak:
     def test_shared_prefix_trace_replay(self, params):

@@ -206,6 +206,78 @@ class TestMetrics:
         assert m["requests_finished"] == len(reqs)
 
 
+class TestSpeculativeParity:
+    """Greedy speculative decoding through the paged engine emits
+    token-for-token the sequential `generate` stream — bf16 AND int8
+    trees (draft and target quantize together). The page-level
+    mechanics (tail pages, rollback) are covered in test_paged_kv.py;
+    here the property is pure end-to-end output parity."""
+
+    def _spec_serve(self, p, prompts, max_new):
+        from paddle_tpu.models.generation import draft_from_params
+        from paddle_tpu.serving import PagedEngine
+
+        dp, da = draft_from_params(p, ARGS, 1)
+        eng = PagedEngine(p, ARGS, max_slots=2, max_len=64, page_size=8,
+                          min_bucket=8, draft_params=dp, draft_args=da,
+                          spec_tokens=3)
+        reqs = eng.serve([Request(x, max_new) for x in prompts])
+        c = eng.metrics.summary()["counters"]
+        assert c["spec_rounds"] > 0   # speculation actually ran
+        return reqs
+
+    def test_spec_greedy_matches_sequential_bf16(self):
+        bp = lf.init_params(ARGS, jax.random.key(2), jnp.bfloat16)
+        prompts = _prompts([5, 12, 21], seed=81)
+        ref = [np.asarray(generate(bp, ARGS, x[None],
+                                   max_new_tokens=6))[0][len(x):]
+               for x in prompts]
+        for r, s in zip(self._spec_serve(bp, prompts, 6), ref):
+            np.testing.assert_array_equal(np.asarray(r.token_ids), s)
+
+    def test_spec_greedy_matches_sequential_int8(self, params):
+        qp = quantize_params(params)
+        prompts = _prompts([5, 12, 21], seed=82)
+        ref = _sequential(qp, prompts, max_new=6)
+        for r, s in zip(self._spec_serve(qp, prompts, 6), ref):
+            np.testing.assert_array_equal(np.asarray(r.token_ids), s)
+
+
+class TestPrefillDoneVsTTFT:
+    """`ttft_s` is recorded at the first EMITTED token and
+    `prefill_done_s` when the prompt is fully in the target's KV cache.
+    On a monolithic prefill they land on the same step; under chunked
+    prefill with a speculative draft the emission waits for the draft
+    mirror's windows, so the two diverge — telemetry keeps both."""
+
+    def test_monolithic_records_both_same_step(self, params, engine):
+        (p,) = _prompts([9], seed=91)
+        (r,) = engine.serve([Request(p, 3)])
+        assert r.prefill_done_steps == r.ttft_steps
+        assert 0 <= r.prefill_done_s <= r.ttft_s
+        m = engine.metrics.summary()["observations"]
+        assert m["prefill_done_s"]["count"] >= 1
+        assert m["ttft_s"]["count"] >= 1
+
+    def test_chunked_spec_first_emit_after_prefill_done(self, params):
+        from paddle_tpu.models.generation import draft_from_params
+        from paddle_tpu.serving import PagedEngine
+
+        dp, da = draft_from_params(params, ARGS, 1)
+        eng = PagedEngine(params, ARGS, max_slots=2, max_len=64,
+                          page_size=8, min_bucket=8, prefill_chunk=8,
+                          draft_params=dp, draft_args=da, spec_tokens=3)
+        (p,) = _prompts([21], seed=92)
+        (r,) = eng.serve([Request(p, 3)])
+        # the target's final chunk lands while the draft mirror still has
+        # windows to stream: prompt-cached and first-emit are different
+        # engine steps
+        assert r.prefill_done_steps < r.ttft_steps
+        assert r.prefill_done_s <= r.ttft_s
+        m = eng.metrics.summary()["observations"]
+        assert m["prefill_done_steps"]["max"] < m["ttft_steps"]["max"]
+
+
 class TestProfileWiring:
     def test_predictor_records_wall_time_and_calls(self, tmp_path):
         import paddle_tpu as paddle

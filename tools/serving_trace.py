@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["make_trace", "trace_stats"]
+__all__ = ["make_trace", "make_mixed_trace", "trace_stats"]
 
 
 def make_trace(seed=0, n_requests=24, mean_interarrival_steps=2.0,
@@ -68,6 +68,49 @@ def make_trace(seed=0, n_requests=24, mean_interarrival_steps=2.0,
     return trace
 
 
+def make_mixed_trace(seed=0, n_short=24, short_len_choices=(3, 5, 7, 9, 12),
+                     n_long=2, long_len=192, burst_step=None,
+                     mean_interarrival_steps=2.0, new_tokens_choices=(8,),
+                     long_new_tokens=8, vocab_size=128, pad_id=0):
+    """A LONG-PROMPT BURST dropped into a short-prompt stream — the TTFT
+    acceptance trace for chunked prefill. Shorts arrive as the usual
+    Poisson-ish stream; `n_long` long prompts all arrive at `burst_step`
+    (default: mid-stream), so the shorts queued right behind them measure
+    exactly how long a monolithic long prefill stalls the scheduler
+    (chunked prefill interleaves instead and their TTFT stays flat).
+    Entries carry a 'long' flag so benchmarks can split TTFT quantiles by
+    class. Deterministic for a given seed."""
+    shorts = make_trace(seed=seed, n_requests=n_short,
+                        mean_interarrival_steps=mean_interarrival_steps,
+                        prompt_len_choices=tuple(short_len_choices),
+                        new_tokens_choices=tuple(new_tokens_choices),
+                        vocab_size=vocab_size, pad_id=pad_id)
+    for t in shorts:
+        t["long"] = False
+    if burst_step is None:
+        arr = sorted(t["arrival_step"] for t in shorts)
+        burst_step = arr[len(arr) // 2]
+    rng = np.random.default_rng(seed + 101)
+    longs = []
+    for i in range(n_long):
+        prompt = rng.integers(1, vocab_size, size=int(long_len)).astype(
+            np.int32)
+        if pad_id != 0:
+            prompt[prompt == pad_id] = (pad_id + 1) % vocab_size or 1
+        longs.append({
+            "request_id": n_short + i,
+            "arrival_step": int(burst_step),
+            "prompt": prompt,
+            "max_new_tokens": int(long_new_tokens),
+            "shared_prefix": False,
+            "long": True,
+        })
+    # longs land FIRST at the burst step: the FIFO queue puts the shorts
+    # arriving at/after it right behind the monolithic prefills
+    return sorted(shorts + longs,
+                  key=lambda t: (t["arrival_step"], not t["long"]))
+
+
 def trace_stats(trace):
     plens = [len(t["prompt"]) for t in trace]
     return {
@@ -79,6 +122,7 @@ def trace_stats(trace):
         "last_arrival_step": max(t["arrival_step"] for t in trace),
         "shared_prefix_requests": sum(1 for t in trace
                                       if t.get("shared_prefix")),
+        "long_requests": sum(1 for t in trace if t.get("long")),
     }
 
 
@@ -92,11 +136,21 @@ if __name__ == "__main__":
     ap.add_argument("--mean-gap", type=float, default=2.0)
     ap.add_argument("--shared-prefix-len", type=int, default=0)
     ap.add_argument("--shared-prefix-ratio", type=float, default=1.0)
+    ap.add_argument("--mixed", action="store_true",
+                    help="long-prompt burst into a short stream "
+                         "(the chunked-prefill TTFT trace)")
+    ap.add_argument("--n-long", type=int, default=2)
+    ap.add_argument("--long-len", type=int, default=192)
     args = ap.parse_args()
-    trace = make_trace(seed=args.seed, n_requests=args.n,
-                       mean_interarrival_steps=args.mean_gap,
-                       shared_prefix_len=args.shared_prefix_len,
-                       shared_prefix_ratio=args.shared_prefix_ratio)
+    if args.mixed:
+        trace = make_mixed_trace(seed=args.seed, n_short=args.n,
+                                 n_long=args.n_long, long_len=args.long_len,
+                                 mean_interarrival_steps=args.mean_gap)
+    else:
+        trace = make_trace(seed=args.seed, n_requests=args.n,
+                           mean_interarrival_steps=args.mean_gap,
+                           shared_prefix_len=args.shared_prefix_len,
+                           shared_prefix_ratio=args.shared_prefix_ratio)
     print(json.dumps({
         "stats": trace_stats(trace),
         "requests": [{"request_id": t["request_id"],
