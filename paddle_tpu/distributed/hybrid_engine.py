@@ -60,7 +60,7 @@ def _factored_leaf(shape):
     return len(shape) >= 2
 
 
-def adamw_init(params, moments="f32"):
+def adamw_init(params, moments="f32", master_weights=False):
     """AdamW state with selectable moment storage (the memory knob that
     decides how much HBM is left for activations — reference keeps f32
     moments unconditionally, `python/paddle/optimizer/adamw.py` moment1/2
@@ -72,6 +72,12 @@ def adamw_init(params, moments="f32"):
       - 'factored': m stored bf16; v replaced by Adafactor-style f32
                     row/col EMAs of g^2 over the last two axes
                     (~2 bytes/param total). Rank<2 leaves keep full f32 v.
+
+    master_weights: keep an f32 master copy of each param in the state and
+    apply updates to IT (bf16 params are then a pure down-cast view) —
+    the mixed-precision recipe when per-step updates underflow bf16's
+    8 mantissa bits. Costs 4 bytes/param; off by default to preserve the
+    bench configs' HBM headroom.
     """
     if moments not in ("f32", "bf16", "factored"):
         raise ValueError(f"moments must be f32|bf16|factored, got {moments!r}")
@@ -84,9 +90,13 @@ def adamw_init(params, moments="f32"):
         return jnp.zeros(p.shape, jnp.float32 if moments != "bf16"
                          else jnp.bfloat16)
 
-    return {"m": jax.tree.map(lambda p: jnp.zeros(p.shape, mdt), params),
-            "v": jax.tree.map(mk_v, params),
-            "step": jnp.zeros((), jnp.int32)}
+    state = {"m": jax.tree.map(lambda p: jnp.zeros(p.shape, mdt), params),
+             "v": jax.tree.map(mk_v, params),
+             "step": jnp.zeros((), jnp.int32)}
+    if master_weights:
+        state["master"] = jax.tree.map(
+            lambda p: p.astype(jnp.float32), params)
+    return state
 
 
 def adamw_update(params, grads, state, lr=3e-4, beta1=0.9, beta2=0.999,
@@ -107,7 +117,7 @@ def adamw_update(params, grads, state, lr=3e-4, beta1=0.9, beta2=0.999,
         return _stochastic_round_bf16(
             jax.random.fold_in(base_key, 2 * leaf_idx + slot), x32)
 
-    def upd(i, p, g, m, v):
+    def upd(i, p, g, m, v, master):
         g32 = g.astype(jnp.float32)
         m32 = beta1 * m.astype(jnp.float32) + (1 - beta1) * g32
         if isinstance(v, dict):  # factored second moment
@@ -125,20 +135,28 @@ def adamw_update(params, grads, state, lr=3e-4, beta1=0.9, beta2=0.999,
             # only the 'bf16' mode rounds the second moment down
             new_v = store(v32, i, 1) if moments == "bf16" else v32
         mhat = m32 / b1t
-        p32 = p.astype(jnp.float32)
+        # master weights: the f32 copy in the state is the source of truth;
+        # the (possibly bf16) param is just its down-cast
+        p32 = master if master is not None else p.astype(jnp.float32)
         p32 = p32 - lr * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p32)
-        return p32.astype(p.dtype), store(m32, i, 0), new_v
+        return p32.astype(p.dtype), store(m32, i, 0), new_v, p32
 
     flat_p, tdef = jax.tree.flatten(params)
     flat_g = tdef.flatten_up_to(grads)
     flat_m = tdef.flatten_up_to(state["m"])
     flat_v = tdef.flatten_up_to(state["v"])
-    out = [upd(i, p, g, m, v)
-           for i, (p, g, m, v) in enumerate(zip(flat_p, flat_g, flat_m, flat_v))]
+    flat_mw = (tdef.flatten_up_to(state["master"])
+               if "master" in state else [None] * len(flat_p))
+    out = [upd(i, p, g, m, v, mw)
+           for i, (p, g, m, v, mw)
+           in enumerate(zip(flat_p, flat_g, flat_m, flat_v, flat_mw))]
     new_p = tdef.unflatten([o[0] for o in out])
     new_m = tdef.unflatten([o[1] for o in out])
     new_v = tdef.unflatten([o[2] for o in out])
-    return new_p, {"m": new_m, "v": new_v, "step": step}
+    new_state = {"m": new_m, "v": new_v, "step": step}
+    if "master" in state:
+        new_state["master"] = tdef.unflatten([o[3] for o in out])
+    return new_p, new_state
 
 
 # --------------------------------------------------------------------------
@@ -158,7 +176,7 @@ class HybridParallelEngine:
                  devices=None, dtype=jnp.float32, remat=True, lr=3e-4,
                  schedule="gpipe", num_virtual_stages=2, zero_stage=1,
                  loss_chunk=None, moments="f32", cp=1, cp_mode="ring",
-                 unroll=None, monitor=None):
+                 unroll=None, monitor=None, master_weights=False):
         from paddle_tpu.models.llama import LlamaConfig  # noqa: F401 (type)
 
         self.config = config
@@ -211,6 +229,9 @@ class HybridParallelEngine:
         # f32 logits never materialize at once — vocab matmul + CE run per
         # seq chunk with rematerialization (forward_and_loss loss_chunk)
         self.loss_chunk = loss_chunk
+        # f32 master copies of the params inside the opt state (see
+        # adamw_init); off by default — costs 4 bytes/param of HBM
+        self.master_weights = bool(master_weights)
         # moment storage: 'f32' | 'bf16' (stochastic-rounded) | 'factored'
         # (Adafactor-style second moment). On a 16G chip the f32 moments of
         # a ~1B model (7.5GB) are what force remat in the first place.
@@ -424,6 +445,10 @@ class HybridParallelEngine:
             "v": jax.tree.map(v_shard, specs_tree, shapes),
             "step": self._sharding(P()),
         }
+        if self.master_weights:
+            self._opt_shardings["master"] = jax.tree.map(
+                lambda sp, sh: self._sharding(
+                    self._zero_spec(sp, sh.shape)), specs_tree, shapes)
 
     def _vpp_perm(self):
         """Leading-dim permutation of the stacked layers for the interleaved
@@ -455,8 +480,10 @@ class HybridParallelEngine:
             make = lambda k: lf.init_params(args, k, dtype)  # noqa: E731
         init_fn = jax.jit(make, out_shardings=self._param_shardings)
         params = init_fn(key)
-        opt_init = jax.jit(functools.partial(adamw_init, moments=self.moments),
-                           out_shardings=self._opt_shardings)
+        opt_init = jax.jit(functools.partial(
+            adamw_init, moments=self.moments,
+            master_weights=self.master_weights),
+            out_shardings=self._opt_shardings)
         opt_state = opt_init(params)
         return params, opt_state
 
@@ -504,8 +531,14 @@ class HybridParallelEngine:
             h = lf.rms_norm(h, lp["final_norm"], args.rms_eps)
             if sp and mp_axis:
                 h = jax.lax.all_gather(h, mp_axis, axis=1, tiled=True)
-            logits = h @ lp["lm_head"]
             labm = jax.lax.dynamic_index_in_dim(labels, idx, 0, keepdims=False)
+            if self.loss_chunk:
+                # fused streamed lm_head+CE: no [mb, s, vocab] logits buffer
+                # even on the vocab-parallel path
+                return lf.fused_linear_cross_entropy(
+                    h, lp["lm_head"], labm, args, mp_axis, mp,
+                    int(self.loss_chunk))
+            logits = h @ lp["lm_head"]
             return lf.parallel_cross_entropy(logits, labm, args, mp_axis, mp)
 
         def zero_loss(ref):

@@ -32,7 +32,53 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from paddle_tpu.kernels import tuning
+
 _NEG_INF = -1e30
+
+# autotune candidate grid (filtered per shape by _pick_block divisibility);
+# tools/perf_sweep.py --blocks sweeps the same grid end-to-end
+_BLOCK_CANDIDATES = (
+    {"block_q": 256, "block_k": 256},
+    {"block_q": 256, "block_k": 512},
+    {"block_q": 512, "block_k": 256},
+    {"block_q": 512, "block_k": 512},
+    {"block_q": 512, "block_k": 1024},
+    {"block_q": 1024, "block_k": 512},
+    {"block_q": 1024, "block_k": 1024},
+)
+
+
+def _mk_measure(which, q_shape, k_shape, dtype, causal, sm_scale):
+    """Build the autotuner's measure(blocks) -> seconds probe: compile the
+    kernel at the candidate blocks on synthetic inputs and time it. Only
+    invoked when PADDLE_KERNEL_AUTOTUNE=1 on a real TPU backend."""
+
+    def measure(blocks):
+        import time
+
+        q = jnp.zeros(q_shape, dtype)
+        k = jnp.zeros(k_shape, dtype)
+        v = jnp.zeros(k_shape, dtype)
+        bq, bk = blocks["block_q"], blocks["block_k"]
+        if which == "fwd":
+            fn = jax.jit(lambda q, k, v: _flash_fwd(
+                q, k, v, causal, sm_scale, bq, bk)[0])
+            args = (q, k, v)
+        else:
+            o, lse = jax.jit(functools.partial(
+                _flash_fwd, causal=causal, sm_scale=sm_scale))(q, k, v)
+            fn = jax.jit(lambda q, k, v, o, lse: _flash_bwd(
+                q, k, v, o, lse, q, causal, sm_scale, bq, bk)[0])
+            args = (q, k, v, o, lse)
+        fn(*args).block_until_ready()  # compile outside the timed region
+        t0 = time.perf_counter()
+        for _ in range(3):
+            out = fn(*args)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / 3
+
+    return measure
 
 
 def _pick_block(seq, preferred, floor=128, fallback=None):
@@ -120,13 +166,25 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
                               _NEG_INF)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q=512, block_k=1024,
+def _flash_fwd(q, k, v, causal, sm_scale, block_q=None, block_k=None,
                interpret=False):
     """q: [B, H, Sq, D]; k/v: [B, Hk, Sk, D] -> (out [B, H, Sq, D],
-    lse [B, H, Sq, 1] f32). Seq lengths must be multiples of 128."""
+    lse [B, H, Sq, 1] f32). Seq lengths must be multiples of 128.
+
+    block_q/block_k default to the autotuner's pick for this (shape, dtype,
+    chip); pass them explicitly to pin (the sweep/measure path does)."""
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     g = h // hk
+    if block_q is None or block_k is None:
+        picked = tuning.get_blocks(
+            "flash_fwd", {"seq_q": sq, "seq_k": sk, "head_dim": d}, q.dtype,
+            {"block_q": 512, "block_k": 1024},
+            measure=_mk_measure("fwd", q.shape, k.shape, q.dtype, causal,
+                                sm_scale),
+            candidates=_BLOCK_CANDIDATES)
+        block_q = picked["block_q"] if block_q is None else block_q
+        block_k = picked["block_k"] if block_k is None else block_k
     block_q = _pick_block(sq, min(block_q, sq))
     block_k = _pick_block(sk, min(block_k, sk))
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
@@ -264,16 +322,26 @@ def _bwd_dkv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dv_ref[0, 0] += dv
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q=512,
-               block_k=1024, interpret=False, g_lse=None):
+def _flash_bwd(q, k, v, o, lse, do, causal, sm_scale, block_q=None,
+               block_k=None, interpret=False, g_lse=None):
     """All operands in [B, H(:k), S, D]; returns (dq, dk, dv) with dk/dv in
     f32 (caller casts). g_lse [B, H, Sq, 1]: cotangent of the logsumexp
     output (ring attention's merge differentiates through lse); folding it
     into delta is exact because dlse_i/ds_ij = p_ij, the same softmax
-    weights delta multiplies."""
+    weights delta multiplies. block_q/block_k default to the autotuner's
+    pick; explicit values pin them."""
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     g = h // hk
+    if block_q is None or block_k is None:
+        picked = tuning.get_blocks(
+            "flash_bwd", {"seq_q": sq, "seq_k": sk, "head_dim": d}, q.dtype,
+            {"block_q": 512, "block_k": 1024},
+            measure=_mk_measure("bwd", q.shape, k.shape, q.dtype, causal,
+                                sm_scale),
+            candidates=_BLOCK_CANDIDATES)
+        block_q = picked["block_q"] if block_q is None else block_q
+        block_k = picked["block_k"] if block_k is None else block_k
     block_q = _pick_block(sq, min(block_q, sq))
     block_k = _pick_block(sk, min(block_k, sk))
     # delta_i = rowsum(dO_i * O_i): plain XLA, fuses into one pass.

@@ -132,15 +132,25 @@ def _dqmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, block_k, n_kb,
         o_ref[...] = (acc_ref[...] * (s_ref[0] / 127.0)).astype(o_ref.dtype)
 
 
-def fused_dequant_matmul(x, w, scale, out_dtype=None, block_m=256,
-                         block_n=512, block_k=512, interpret=False):
+def fused_dequant_matmul(x, w, scale, out_dtype=None, block_m=None,
+                         block_n=None, block_k=None, interpret=False):
     """`x @ (w * scale / 127)` with w int8 [K, N] staying int8 through HBM
     and VMEM; scale [N] is the per-output-channel absmax. x: [..., K]
     (leading dims flatten into M — decode batches are tiny, the M tile pads).
     Tile-remainder shapes on any of M/N/K are handled by in-kernel masking
-    (K) and dropped out-of-range writes (M/N)."""
+    (K) and dropped out-of-range writes (M/N). Tiles default to the
+    autotuner's pick for this (shape, dtype, chip); explicit values pin."""
     *lead, k_total = x.shape
     n_total = w.shape[1]
+    if block_m is None or block_n is None or block_k is None:
+        from paddle_tpu.kernels import tuning
+
+        picked = tuning.get_blocks(
+            "dequant_matmul", {"k": k_total, "n": n_total}, x.dtype,
+            {"block_m": 256, "block_n": 512, "block_k": 512})
+        block_m = picked["block_m"] if block_m is None else block_m
+        block_n = picked["block_n"] if block_n is None else block_n
+        block_k = picked["block_k"] if block_k is None else block_k
     x2 = x.reshape(-1, k_total)
     m_total = x2.shape[0]
     out_dtype = out_dtype or x.dtype
@@ -479,7 +489,7 @@ def _window_attention_xla(q, cache_k, cache_v, pos, sm_scale):
 
 
 def window_decode_attention(q, cache_k, cache_v, pos, scale=None,
-                            block_k=512):
+                            block_k=None):
     """Attention of a SHORT query window q [b, s, nh, hd] over the
     fixed-size cache [b, nkv, max_len, hd]: query i of row r sits at
     position pos[r] + i and attends keys [0, pos[r] + i]. pos may be a
@@ -489,6 +499,12 @@ def window_decode_attention(q, cache_k, cache_v, pos, scale=None,
     max/sum stops at the last query's watermark; GQA native), the masked
     jnp composition elsewhere."""
     sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if block_k is None:
+        from paddle_tpu.kernels import tuning
+
+        block_k = tuning.get_blocks(
+            "decode_attention", {"seq": cache_k.shape[2]}, q.dtype,
+            {"block_k": 512})["block_k"]
     use_pallas, interpret = _mode()
     if use_pallas and window_supported(q.shape, cache_k.shape,
                                        q.dtype.itemsize):
@@ -662,7 +678,7 @@ def paged_decode_attention(q, pool_k, pool_v, block_tables, pos, scale=None):
                                        sm_scale)
 
 
-def decode_attention(q, cache_k, cache_v, pos, scale=None, block_k=512):
+def decode_attention(q, cache_k, cache_v, pos, scale=None, block_k=None):
     """Single-query attention of q [b, 1, nh, hd] over the fixed-size cache
     [b, nkv, max_len, hd], valid prefix [0, pos] (pos is the traced write
     position of q's own k/v — the decode step of the compiled generate).
@@ -670,6 +686,12 @@ def decode_attention(q, cache_k, cache_v, pos, scale=None, block_k=512):
     positions, the continuous-batching decode step where every slot sits at
     its own sequence depth. GQA native: kv heads are never repeated."""
     sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if block_k is None:
+        from paddle_tpu.kernels import tuning
+
+        block_k = tuning.get_blocks(
+            "decode_attention", {"seq": cache_k.shape[2]}, q.dtype,
+            {"block_k": 512})["block_k"]
     use_pallas, interpret = _mode()
     if use_pallas and decode_supported(q.shape, cache_k.shape,
                                        q.dtype.itemsize):

@@ -60,9 +60,13 @@ def _bwd_kernel(x_ref, w_ref, r_ref, g_ref, dx_ref, dw_ref):
         dw_ref[...] = dw_ref[...] + dw_part
 
 
-def _pick_rows(n, pref=_BLOCK_ROWS):
+def _pick_rows(n, pref=None):
+    from paddle_tpu.kernels import tuning
     from paddle_tpu.kernels.flash_attention import _pick_block
 
+    if pref is None:  # autotuner-resolved; explicit pref pins it
+        pref = tuning.get_blocks("rms_norm", {"rows": n}, jnp.float32,
+                                 {"rows": _BLOCK_ROWS})["rows"]
     return _pick_block(n, pref, floor=8, fallback=1)
 
 

@@ -28,6 +28,7 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class LlamaArgs(NamedTuple):
@@ -372,6 +373,146 @@ def parallel_cross_entropy(logits, labels, args: LlamaArgs, mp_axis=None,
     return jnp.mean(lse - true_logit)
 
 
+def _ce_chunk_stats(h_c, head, labels_c, inv_n, args: LlamaArgs, mp_axis,
+                    mp_degree):
+    """One sequence chunk's CE loss-sum AND input gradients, single pass.
+
+    The Liger-kernel observation: softmax-CE's logits gradient is the
+    closed form (softmax - onehot) / n, already known in forward. Computing
+    it here means backward never re-runs the [b, c, hidden] @ [hidden,
+    vocab] matmul and the full [b, s, vocab] tensor exists in no pass.
+
+    Returns (loss_sum f32 scalar over the chunk's tokens,
+             d_h_c [b, c, hidden] in h's dtype,
+             d_head_c [hidden, vocab_local] f32 — the LOCAL head shard's
+             grad under mp; vocab-sharded like the weight, no collective).
+    """
+    logits = (h_c @ head).astype(jnp.float32)  # [b, c, vocab_local]
+    if mp_axis is None:
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        e = jnp.exp(logits - m)
+        denom = jnp.sum(e, axis=-1, keepdims=True)
+        lse = jnp.log(denom[..., 0]) + m[..., 0]
+        true_logit = jnp.take_along_axis(
+            logits, labels_c[..., None], axis=-1)[..., 0]
+        iota = jax.lax.broadcasted_iota(labels_c.dtype, logits.shape, 2)
+        onehot = (labels_c[..., None] == iota).astype(jnp.float32)
+        d_logits = (e / denom - onehot) * inv_n
+    else:
+        per = args.vocab_size // mp_degree
+        rank = jax.lax.axis_index(mp_axis)
+        start = rank * per
+        m_local = jnp.max(logits, axis=-1, keepdims=True)
+        m = jax.lax.pmax(jax.lax.stop_gradient(m_local), mp_axis)
+        e = jnp.exp(logits - m)
+        denom = jax.lax.psum(jnp.sum(e, axis=-1, keepdims=True), mp_axis)
+        lse = jnp.log(denom[..., 0]) + m[..., 0]
+        local_lab = labels_c - start
+        valid = (local_lab >= 0) & (local_lab < per)
+        ll = jnp.clip(local_lab, 0, per - 1)
+        tl = jnp.take_along_axis(logits, ll[..., None], axis=-1)[..., 0]
+        true_logit = jax.lax.psum(jnp.where(valid, tl, 0.0), mp_axis)
+        iota = jax.lax.broadcasted_iota(ll.dtype, logits.shape, 2)
+        onehot = ((ll[..., None] == iota)
+                  & valid[..., None]).astype(jnp.float32)
+        d_logits = (e / denom - onehot) * inv_n
+    loss_sum = jnp.sum(lse - true_logit)
+    dl = d_logits.astype(h_c.dtype)
+    d_h = dl @ head.T  # [b, c, hidden]; partial over the local vocab shard
+    if mp_axis is not None:
+        d_h = jax.lax.psum(d_h, mp_axis)
+    d_head = jnp.einsum("bch,bcv->hv", h_c, dl,
+                        preferred_element_type=jnp.float32)
+    return loss_sum, d_h.astype(h_c.dtype), d_head
+
+
+def _fused_ce_loss_only(h, head, labels, args: LlamaArgs, mp_axis, mp_degree,
+                        chunk):
+    """Primal (not-being-differentiated) path: stream loss only."""
+    b, s, _ = h.shape
+    chunk = max(1, min(int(chunk), s))
+    nfull, rem = s // chunk, s % chunk
+    hc = jnp.swapaxes(
+        h[:, :nfull * chunk].reshape(b, nfull, chunk, h.shape[-1]), 0, 1)
+    lc = jnp.swapaxes(
+        labels[:, :nfull * chunk].reshape(b, nfull, chunk), 0, 1)
+
+    def body(loss_sum, xs):
+        h_c, l_c = xs
+        per_tok = parallel_cross_entropy(h_c @ head, l_c, args, mp_axis,
+                                         mp_degree)
+        return loss_sum + per_tok * (b * chunk), None
+
+    loss_sum, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (hc, lc))
+    if rem:
+        per_tok = parallel_cross_entropy(
+            h[:, nfull * chunk:] @ head, labels[:, nfull * chunk:], args,
+            mp_axis, mp_degree)
+        loss_sum = loss_sum + per_tok * (b * rem)
+    return loss_sum / (b * s)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def fused_linear_cross_entropy(h, head, labels, args: LlamaArgs,
+                               mp_axis=None, mp_degree=1, chunk=128):
+    """lm_head matmul + softmax CE, streamed over sequence chunks.
+
+    Mean CE over all b*s tokens, numerically matching
+    `parallel_cross_entropy(h @ head, labels, ...)` — but the [b, s, vocab]
+    logits never materialize in forward OR backward: forward computes each
+    chunk's loss and d(hidden)/d(head) in one pass (peak extra memory is
+    one [b, chunk, vocab] block + the stored d_h/d_head, vs. the remat
+    trick's full re-matmul in backward). Composes with the vocab-parallel
+    (mp_axis) path: softmax statistics psum over the shards, d_head stays
+    the local shard's grad. Any s, including s % chunk != 0 (remainder
+    handled as a final short chunk).
+    """
+    return _fused_ce_loss_only(h, head, labels, args, mp_axis, mp_degree,
+                               chunk)
+
+
+def _fused_ce_fwd(h, head, labels, args: LlamaArgs, mp_axis, mp_degree,
+                  chunk):
+    b, s, hidden = h.shape
+    chunk = max(1, min(int(chunk), s))
+    inv_n = 1.0 / (b * s)
+    nfull, rem = s // chunk, s % chunk
+    hc = jnp.swapaxes(
+        h[:, :nfull * chunk].reshape(b, nfull, chunk, hidden), 0, 1)
+    lc = jnp.swapaxes(
+        labels[:, :nfull * chunk].reshape(b, nfull, chunk), 0, 1)
+
+    def body(carry, xs):
+        loss_sum, d_head = carry
+        h_c, l_c = xs
+        ls, d_h_c, d_hd = _ce_chunk_stats(h_c, head, l_c, inv_n, args,
+                                          mp_axis, mp_degree)
+        return (loss_sum + ls, d_head + d_hd), d_h_c
+
+    carry0 = (jnp.zeros((), jnp.float32),
+              jnp.zeros((hidden, head.shape[-1]), jnp.float32))
+    (loss_sum, d_head), d_h_chunks = jax.lax.scan(body, carry0, (hc, lc))
+    d_h = jnp.swapaxes(d_h_chunks, 0, 1).reshape(b, nfull * chunk, hidden)
+    if rem:
+        ls, d_h_r, d_hd = _ce_chunk_stats(
+            h[:, nfull * chunk:], head, labels[:, nfull * chunk:], inv_n,
+            args, mp_axis, mp_degree)
+        loss_sum = loss_sum + ls
+        d_head = d_head + d_hd
+        d_h = jnp.concatenate([d_h, d_h_r], axis=1)
+    res = (d_h, d_head.astype(head.dtype), labels)
+    return loss_sum * jnp.float32(inv_n), res
+
+
+def _fused_ce_bwd(args, mp_axis, mp_degree, chunk, res, g):
+    d_h, d_head, labels = res
+    return (d_h * g.astype(d_h.dtype), d_head * g.astype(d_head.dtype),
+            np.zeros(labels.shape, dtype=jax.dtypes.float0))
+
+
+fused_linear_cross_entropy.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
 def forward(params, ids, args: LlamaArgs, mp_axis=None, mp_degree=1, sp=False,
             remat=True, unroll=False):
     """Full forward to logits. ids: [b, s] int32."""
@@ -383,31 +524,17 @@ def forward(params, ids, args: LlamaArgs, mp_axis=None, mp_degree=1, sp=False,
 def forward_and_loss(params, ids, labels, args: LlamaArgs, mp_axis=None,
                      mp_degree=1, sp=False, remat=True, loss_chunk=None,
                      unroll=False):
-    """loss_chunk: sequence-chunked final matmul + CE — the [b, s, vocab]
-    logits never materialize at once (peak memory drops by ~s/chunk), at
-    the cost of rematerializing each chunk's vocab matmul in backward.
-    Only the mp_axis=None path supports chunking (the vocab-parallel CE
-    already shards the vocab dim)."""
-    if loss_chunk and mp_axis is None and ids.shape[1] % loss_chunk == 0:
+    """loss_chunk: fused sequence-chunked lm_head + CE
+    (`fused_linear_cross_entropy`) — the [b, s, vocab] logits never
+    materialize in forward or backward (peak memory drops by ~s/chunk) and
+    backward re-runs no vocab matmul. Works on the vocab-parallel
+    (mp_axis) path too, and for any s (remainder chunks included)."""
+    if loss_chunk:
         h = forward_hidden(params, ids, args, mp_axis, mp_degree, sp, remat,
                            unroll=unroll)
-        head = params["lm_head"]
-        nchunk = ids.shape[1] // loss_chunk
-        hc = h.reshape(h.shape[0], nchunk, loss_chunk, h.shape[-1])
-        lc = labels.reshape(labels.shape[0], nchunk, loss_chunk)
-        hc = jnp.swapaxes(hc, 0, 1)  # [nchunk, b, chunk, h]
-        lc = jnp.swapaxes(lc, 0, 1)
-
-        @jax.checkpoint
-        def chunk_loss(carry, xs):
-            hcc, lcc = xs
-            logits = hcc @ head
-            loss = parallel_cross_entropy(logits, lcc, args, None, 1)
-            return carry + loss, None
-
-        total, _ = jax.lax.scan(chunk_loss, jnp.zeros((), jnp.float32),
-                                (hc, lc))
-        return total / nchunk
+        return fused_linear_cross_entropy(h, params["lm_head"], labels,
+                                          args, mp_axis, mp_degree,
+                                          int(loss_chunk))
     logits = forward(params, ids, args, mp_axis, mp_degree, sp, remat,
                      unroll=unroll)
     return parallel_cross_entropy(logits, labels, args, mp_axis, mp_degree)

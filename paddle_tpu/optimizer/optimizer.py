@@ -7,6 +7,9 @@ The compiled trainer (`paddle_tpu.hapi` / `paddle_tpu.jit`) uses the same
 step — the analogue of the reference's fused multi-tensor `_C_ops.adamw_`.
 """
 
+import functools
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -250,6 +253,65 @@ class RMSProp(Optimizer):
         p._data = (p._data - v).astype(p.dtype)
 
 
+@functools.partial(jax.jit, static_argnames=(
+    "beta1", "beta2", "eps", "multi_precision", "bf16_moments", "leaf_cfg",
+    "adamw"))
+def _fused_adam_apply(ps, gs, ms, vs, masters, lr, b1t, b2t, base_key,
+                      beta1, beta2, eps, multi_precision, bf16_moments,
+                      leaf_cfg, adamw):
+    """The whole Adam/AdamW step as ONE jitted tree-level program.
+
+    The eager per-param loop dispatches ~10 XLA ops per parameter per step,
+    each materializing its f32 intermediates in HBM — for bf16 moments that
+    is a full f32 round-trip of the optimizer state every step. Fused, XLA
+    keeps the f32 math in registers: moments stay bf16 end-to-end in memory
+    while master weights (multi_precision) update in f32.
+
+    Semantics are the eager path's exactly: per-leaf statics in `leaf_cfg`
+    = (lr_scale, reg_coeff, l2_coeff, decay, sr_slot); b1t/b2t are the
+    bias corrections 1-beta^t computed host-side (t is concrete), so one
+    compilation serves every step.
+    """
+    from paddle_tpu.core.numerics import stochastic_round_bf16
+
+    lr = lr.astype(jnp.float32)
+    new_p, new_m, new_v, new_master = [], [], [], []
+    for i, (p, g, m, v) in enumerate(zip(ps, gs, ms, vs)):
+        lr_scale, reg, l2, decay, slot = leaf_cfg[i]
+        plr = lr * lr_scale
+        # regularizer + Adam L2 run in g's dtype, as the eager path does
+        if reg:
+            g = g + reg * p.astype(g.dtype)
+        if l2:
+            g = g + l2 * p.astype(g.dtype)
+        p_work = p
+        if adamw and decay:
+            p_work = (p_work * (1.0 - plr * decay).astype(p.dtype)) \
+                .astype(p.dtype)
+        g32 = g.astype(jnp.float32)
+        m32 = beta1 * m.astype(jnp.float32) + (1 - beta1) * g32
+        v32 = beta2 * v.astype(jnp.float32) + (1 - beta2) * g32 * g32
+        if bf16_moments:
+            key = jax.random.fold_in(base_key, slot)
+            m_store = stochastic_round_bf16(jax.random.fold_in(key, 0), m32)
+            v_store = stochastic_round_bf16(jax.random.fold_in(key, 1), v32)
+        else:
+            m_store, v_store = m32, v32
+        mhat = m32 / b1t
+        vhat = v32 / b2t
+        master = p_work.astype(jnp.float32)
+        if multi_precision and masters is not None:
+            master = masters[i]
+        new = master - plr * mhat / (jnp.sqrt(vhat) + eps)
+        new_p.append(new.astype(p.dtype))
+        new_m.append(m_store)
+        new_v.append(v_store)
+        if multi_precision:
+            new_master.append(new)
+    return (tuple(new_p), tuple(new_m), tuple(new_v),
+            tuple(new_master) if multi_precision else None)
+
+
 class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
@@ -267,6 +329,73 @@ class Adam(Optimizer):
         if moment_dtype not in ("float32", "bfloat16"):
             raise ValueError("moment_dtype must be 'float32' or 'bfloat16'")
         self._moment_dtype = jnp.dtype(moment_dtype)
+        self._is_adamw = False
+        # one jitted tree-level update per step (see _fused_adam_apply);
+        # set False to fall back to the eager per-param loop
+        self._fuse_step = True
+
+    def _leaf_decay_cfg(self, p, lr_scale):
+        """(extra lr scale, Adam-style L2 coeff, AdamW decoupled decay)."""
+        l2 = float(self._weight_decay) if self._weight_decay else 0.0
+        return lr_scale, l2, 0.0
+
+    def step(self):
+        if not self._fuse_step:
+            return super().step()
+        assert self._parameter_list is not None, \
+            "optimizer created without parameters"
+        with no_grad():
+            params_grads = [(p, p.grad._data) for p in self._parameter_list
+                            if p.grad is not None and not p.stop_gradient]
+            params_grads = self._apply_grad_clip(params_grads)
+            lr = self.get_lr()
+            self._step_count += 1
+            if not params_grads:
+                return
+            t = self._step_count
+            bf16_m = self._moment_dtype == jnp.bfloat16
+            mdt = self._moment_dtype
+            slots = self.__dict__.setdefault("_sr_slots", {})
+            ps, gs, ms, vs, masters, cfg = [], [], [], [], [], []
+            for p, g in params_grads:
+                lr_scale = (float(p.optimize_attr.get("learning_rate", 1.0))
+                            if isinstance(p, Parameter) else 1.0)
+                reg = 0.0
+                if (getattr(p, "regularizer", None) is not None
+                        and hasattr(p.regularizer, "coeff")):
+                    reg = float(p.regularizer.coeff)
+                lr_scale, l2, decay = self._leaf_decay_cfg(p, lr_scale)
+                slot = slots.setdefault(id(p), len(slots)) if bf16_m else 0
+                ps.append(p._data)
+                gs.append(g)
+                ms.append(self._acc("moment1", p,
+                                    jnp.zeros_like(p._data, mdt)))
+                vs.append(self._acc("moment2", p,
+                                    jnp.zeros_like(p._data, mdt)))
+                if self._multi_precision:
+                    masters.append(
+                        self._accumulators.get(("master", id(p))))
+                cfg.append((lr_scale, reg, l2, decay, slot))
+            have_masters = (self._multi_precision
+                            and all(m is not None for m in masters))
+            base_key = jax.random.key(t) if bf16_m else jax.random.key(0)
+            new_p, new_m, new_v, new_masters = _fused_adam_apply(
+                tuple(ps), tuple(gs), tuple(ms), tuple(vs),
+                tuple(masters) if have_masters else None,
+                jnp.float32(lr),
+                jnp.float32(1.0 - self._beta1 ** t),
+                jnp.float32(1.0 - self._beta2 ** t),
+                base_key,
+                beta1=self._beta1, beta2=self._beta2, eps=self._epsilon,
+                multi_precision=self._multi_precision,
+                bf16_moments=bf16_m, leaf_cfg=tuple(cfg),
+                adamw=self._is_adamw)
+            for i, (p, _) in enumerate(params_grads):
+                p._data = new_p[i]
+                self._set_acc("moment1", p, new_m[i])
+                self._set_acc("moment2", p, new_v[i])
+                if self._multi_precision:
+                    self._set_acc("master", p, new_masters[i])
 
     def _decay(self, p, g):
         if self._weight_decay:
@@ -323,6 +452,16 @@ class AdamW(Adam):
         self._wd = weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
+        self._is_adamw = True
+
+    def _leaf_decay_cfg(self, p, lr_scale):
+        if self._lr_ratio is not None:
+            lr_scale = lr_scale * float(self._lr_ratio(p))
+        decay = float(self._wd) if self._wd else 0.0
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            decay = 0.0
+        return lr_scale, 0.0, decay
 
     def _update_param(self, p, g, lr):
         if self._lr_ratio is not None:

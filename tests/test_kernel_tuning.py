@@ -1,0 +1,236 @@
+"""Kernel block-size autotuner (kernels/tuning.py).
+
+Pins the resolution order — env override > on-disk cache > live
+measurement (TPU-gated) > deterministic fallback — plus shape bucketing,
+crash-tolerant measurement, and the telemetry contract: every resolved
+pick lands as a `kernel_block` gauge so `--telemetry-out` artifacts show
+the blocks a run actually compiled with.
+"""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.kernels import tuning
+
+DEFAULTS = {"block_q": 512, "block_k": 1024}
+SHAPE = {"seq_q": 1024, "seq_k": 1024, "head_dim": 128}
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    """Every test gets an empty on-disk cache and no env overrides."""
+    monkeypatch.setenv("PADDLE_TUNING_CACHE", str(tmp_path / "tuning.json"))
+    monkeypatch.delenv("PADDLE_TUNE_BLOCKS", raising=False)
+    monkeypatch.delenv("PADDLE_KERNEL_AUTOTUNE", raising=False)
+    tuning.clear_memory_cache()
+    yield
+    tuning.clear_memory_cache()
+
+
+def _enable_autotune(monkeypatch):
+    monkeypatch.setenv("PADDLE_KERNEL_AUTOTUNE", "1")
+    monkeypatch.setattr(tuning, "_backend", lambda: "tpu")
+
+
+class TestResolution:
+    def test_cpu_fallback(self):
+        """No cache, no env, no TPU: the deterministic table answers, and
+        every defaults key is present in the result."""
+        out = tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS)
+        assert set(out) == set(DEFAULTS)
+        assert out == {"block_q": 512, "block_k": 512}  # s1024 table row
+
+    def test_unknown_kernel_falls_back_to_defaults(self):
+        out = tuning.get_blocks("no_such_kernel", {"seq": 64}, jnp.float32,
+                                {"block": 128})
+        assert out == {"block": 128}
+
+    def test_cold_measure_then_cache_hit(self, monkeypatch):
+        """First call measures every candidate and persists the winner;
+        the second call (fresh process simulated by clearing the memory
+        mirror) hits the on-disk cache without measuring again."""
+        _enable_autotune(monkeypatch)
+        calls = []
+
+        def measure(blocks):
+            calls.append(dict(blocks))
+            return 1.0 if blocks["block_k"] == 512 else 2.0
+
+        cands = [{"block_q": 512, "block_k": 512},
+                 {"block_q": 512, "block_k": 1024}]
+        out = tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS,
+                                measure=measure, candidates=cands)
+        assert out == {"block_q": 512, "block_k": 512}
+        assert len(calls) == 2  # every candidate timed once
+
+        tuning.clear_memory_cache()  # "new process"
+        out2 = tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS,
+                                 measure=measure, candidates=cands)
+        assert out2 == out
+        assert len(calls) == 2  # cache hit: no re-measurement
+
+        on_disk = json.loads(open(tuning.cache_path()).read())
+        assert any(k.startswith("flash_fwd|") for k in on_disk)
+
+    def test_env_override_wins_over_cache(self, monkeypatch):
+        _enable_autotune(monkeypatch)
+        tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS,
+                          measure=lambda b: 1.0,
+                          candidates=[{"block_q": 256, "block_k": 256}])
+        monkeypatch.setenv("PADDLE_TUNE_BLOCKS", json.dumps(
+            {"flash_fwd": {"block_q": 1024}}))
+        out = tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS)
+        assert out["block_q"] == 1024  # env pin
+        assert out["block_k"] == 256  # non-pinned key still cache-resolved
+
+    def test_env_override_ignores_unknown_keys_and_bad_json(self,
+                                                            monkeypatch):
+        monkeypatch.setenv("PADDLE_TUNE_BLOCKS", json.dumps(
+            {"flash_fwd": {"not_a_param": 7, "block_k": 256}}))
+        out = tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS)
+        assert out["block_k"] == 256 and "not_a_param" not in out
+        monkeypatch.setenv("PADDLE_TUNE_BLOCKS", "{not json")
+        with pytest.warns(UserWarning):
+            out = tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16,
+                                    DEFAULTS)
+        assert out == {"block_q": 512, "block_k": 512}
+
+    def test_no_measurement_without_optin_or_tpu(self, monkeypatch):
+        """CPU backend or unset PADDLE_KERNEL_AUTOTUNE must never time
+        candidates (tier-1 runs on CPU: measurement there is noise)."""
+        calls = []
+        tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS,
+                          measure=lambda b: calls.append(b) or 1.0,
+                          candidates=[{"block_q": 256, "block_k": 256}])
+        assert not calls
+        monkeypatch.setenv("PADDLE_KERNEL_AUTOTUNE", "1")  # env but CPU
+        tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS,
+                          measure=lambda b: calls.append(b) or 1.0,
+                          candidates=[{"block_q": 256, "block_k": 256}])
+        assert not calls
+
+    def test_measure_crash_tolerance(self, monkeypatch):
+        """A candidate that fails to lower is skipped; if every candidate
+        dies the fallback row wins (and is cached, so the dead grid is
+        not re-timed every call)."""
+        _enable_autotune(monkeypatch)
+
+        def flaky(blocks):
+            if blocks["block_k"] == 1024:
+                raise RuntimeError("does not lower")
+            return 3.0
+
+        out = tuning.get_blocks(
+            "flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS, measure=flaky,
+            candidates=[{"block_q": 512, "block_k": 1024},
+                        {"block_q": 256, "block_k": 256}])
+        assert out == {"block_q": 256, "block_k": 256}
+
+        tuning.clear_memory_cache()
+        dead = tuning.measure_and_cache(
+            "flash_bwd", SHAPE, "bfloat16",
+            [{"block_q": 512, "block_k": 1024}],
+            lambda b: (_ for _ in ()).throw(RuntimeError("boom")))
+        assert dead == {"block_q": 512, "block_k": 512}  # fallback row
+
+
+class TestBucketing:
+    def test_bucket(self):
+        assert tuning.bucket(1024) == 1024
+        assert tuning.bucket(1536) == 1024
+        assert tuning.bucket(2047) == 1024
+        assert tuning.bucket(2048) == 2048
+        assert tuning.bucket(0) == 0
+
+    def test_bucketed_shapes_share_cache_entry(self, monkeypatch):
+        _enable_autotune(monkeypatch)
+        calls = []
+        cands = [{"block_q": 256, "block_k": 256}]
+        for sq in (1024, 1536):  # same floor-pow2 bucket
+            tuning.get_blocks("flash_fwd",
+                              dict(SHAPE, seq_q=sq, seq_k=sq), jnp.bfloat16,
+                              DEFAULTS, measure=lambda b: calls.append(b)
+                              or 1.0, candidates=cands)
+        assert len(calls) == 1  # 1536 resolved from 1024's entry
+
+
+class TestTelemetry:
+    def test_blocks_land_in_telemetry_artifact(self, tmp_path):
+        """The --telemetry-out contract: after any kernel resolves its
+        blocks, the artifact's gauges carry kernel_block{kernel=...,
+        param=...} with the value the kernel compiled with."""
+        from paddle_tpu.observability import (global_registry,
+                                              write_run_telemetry)
+
+        tuning.get_blocks("flash_fwd", SHAPE, jnp.bfloat16, DEFAULTS)
+        path = tmp_path / "telemetry.json"
+        write_run_telemetry(str(path), record={"metric": "t", "value": 1},
+                            registry=global_registry())
+        art = json.loads(path.read_text())
+        gauges = art["metrics"]["gauges"]["kernel_block"]
+        by_label = {k: v["value"] for k, v in gauges.items()}
+        assert any("kernel=flash_fwd" in k and "param=block_q" in k
+                   for k in by_label), by_label
+        counters = art["metrics"]["counters"]["kernel_tuning_lookups"]
+        assert any("kernel=flash_fwd" in k for k in counters)
+
+    def test_lookup_source_counter(self):
+        from paddle_tpu.observability import global_registry
+
+        tuning.get_blocks("decode_attention", {"seq": 2048}, jnp.bfloat16,
+                          {"block_k": 512})
+        snap = global_registry().snapshot()
+        keys = snap["counters"]["kernel_tuning_lookups"]
+        assert any("kernel=decode_attention" in k and "source=fallback" in k
+                   for k in keys)
+
+
+class TestKernelCallSites:
+    def test_rms_norm_row_pick_uses_tuner(self):
+        from paddle_tpu.kernels import rms_norm as rn
+
+        assert rn._pick_rows(1024) == 256  # fallback-table row
+        assert rn._pick_rows(1024, pref=128) == 128  # explicit pin bypasses
+
+    def test_flash_call_site_resolves_none_blocks(self):
+        """block_q/block_k default to None -> tuner resolution; the
+        interpret-mode kernel must still run and agree with the jnp
+        reference."""
+        import jax
+        import numpy as np
+
+        from paddle_tpu.kernels import flash_attention as fa
+
+        q = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 256, 64),
+                              jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 256, 64),
+                              jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 256, 64),
+                              jnp.float32)
+        out = fa._flash_attention(q, k, v, True, 0.125, True)
+        ref = fa._sdpa_xla(q, k, v, True, 0.125)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-3)
+
+
+@pytest.mark.slow
+def test_live_measurement_picks_a_candidate():
+    """Real on-TPU measurement (PADDLE_KERNEL_AUTOTUNE=1): times the flash
+    candidates and caches a member of the grid. TPU-only by construction —
+    on CPU the gate keeps measurement off, so there is nothing to time."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip("live kernel timing needs a TPU backend")
+    import os
+
+    os.environ["PADDLE_KERNEL_AUTOTUNE"] = "1"
+    tuning.clear_memory_cache()
+    from paddle_tpu.kernels import flash_attention as fa
+
+    q = jax.random.normal(jax.random.PRNGKey(0), (1, 4, 1024, 128),
+                          jnp.bfloat16)
+    out = fa._flash_attention(q, q, q, True, 0.088, False)
+    assert out.shape == q.shape

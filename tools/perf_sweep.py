@@ -6,6 +6,14 @@ timing methodology as the headline benchmark (one implementation), with
 OOM isolation per candidate.
 
 Run:  python tools/perf_sweep.py
+      python tools/perf_sweep.py --blocks   # flash block-size timing grid
+
+`--blocks` sweeps the flash-attention (block_q, block_k) grid end-to-end
+through the train step via the PADDLE_TUNE_BLOCKS env override (the same
+knob kernels/tuning.py resolves last, so each child process runs the
+whole step pinned to one candidate). The printed grid is where the
+checked-in fallback table in kernels/tuning.py comes from; on a chip it
+also validates what the on-device autotuner picked.
 """
 
 from __future__ import annotations
@@ -60,6 +68,47 @@ SPECS = [
 ]
 
 
+# flash (block_q, block_k) grid for --blocks: the v5e-plausible tile sizes
+# (multiples of the 8x128 register tile that fit VMEM at head_dim 128)
+BLOCK_GRID = [(256, 512), (512, 512), (512, 1024), (1024, 512),
+              (1024, 1024)]
+
+
+def main_blocks():
+    """Time the h2048 s1024 train step once per flash block candidate."""
+    spec = {"cfg": H2048, "batch": 8, "seq": 1024, "remat": False,
+            "loss_chunk": 128, "micro_batches": 2}
+    results = []
+    for bq, bk in BLOCK_GRID:
+        env = dict(os.environ)
+        env["PADDLE_TUNE_BLOCKS"] = json.dumps({
+            "flash_fwd": {"block_q": bq, "block_k": bk},
+            "flash_bwd": {"block_q": bq, "block_k": bk}})
+        try:
+            out = subprocess.run(
+                [sys.executable, BENCH, "--single", json.dumps(spec)],
+                capture_output=True, text=True, timeout=900, cwd=REPO,
+                env=env)
+            got = None
+            for line in out.stdout.splitlines():
+                if line.startswith("BENCH_RESULT "):
+                    got = json.loads(line[len("BENCH_RESULT "):])
+            if got:
+                results.append({"block_q": bq, "block_k": bk,
+                                "tps": got["tps"]})
+                print(f"block_q={bq} block_k={bk} -> {got['tps']:.1f} tok/s",
+                      flush=True)
+            else:
+                tail = out.stderr[-500:].replace("\n", " ")
+                print(f"block_q={bq} block_k={bk} -> FAILED: {tail}",
+                      flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"block_q={bq} block_k={bk} -> TIMEOUT", flush=True)
+    if results:
+        best = max(results, key=lambda r: r["tps"])
+        print("BEST_BLOCKS " + json.dumps(best))
+
+
 def main():
     results = []
     for spec in SPECS:
@@ -89,4 +138,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if sys.argv[1:] == ["--blocks"]:
+        main_blocks()
+    else:
+        main()
