@@ -67,6 +67,6 @@ def __getattr__(name):
     raise AttributeError(f"module 'paddle_tpu.distributed' has no attribute {name!r}")
 from paddle_tpu.distributed.api_extras import *  # noqa: F401,F403,E402
 from paddle_tpu.distributed.checkpoint import (  # noqa: F401,E402
-    load_state_dict, save_state_dict,
+    CheckpointManager, load_state_dict, save_state_dict,
 )
 from paddle_tpu.distributed import io  # noqa: F401,E402

@@ -1,9 +1,15 @@
 from paddle_tpu.distributed.checkpoint.save_state_dict import (  # noqa: F401
-    save_state_dict,
+    AsyncSaveHandle, save_state_dict,
 )
 from paddle_tpu.distributed.checkpoint.load_state_dict import (  # noqa: F401
     load_state_dict,
 )
 from paddle_tpu.distributed.checkpoint.metadata import (  # noqa: F401
     Metadata, TensorMetadata,
+)
+from paddle_tpu.distributed.checkpoint.integrity import (  # noqa: F401
+    CheckpointCorruptError, is_committed, verify_snapshot,
+)
+from paddle_tpu.distributed.checkpoint.manager import (  # noqa: F401
+    CheckpointManager,
 )

@@ -1,0 +1,208 @@
+"""Checkpoint integrity: CRC'd shard writes + the COMMITTED manifest.
+
+The commit protocol (CheckFreq-style; Orbax's CheckpointManager has the
+same shape) makes a snapshot directory transition atomic on POSIX:
+
+    write shards + metadata into `<final>.tmp[.<nonce>]`   (staging)
+      -> fsync every file                                   (durable bytes)
+      -> fsync the staging dir                              (durable entries)
+      -> os.replace(staging, final)                         (atomic rename)
+      -> fsync the parent dir                               (durable rename)
+      -> atomic-write + fsync the COMMITTED manifest        (commit point)
+
+A kill -9 at ANY point leaves either the previous committed snapshot, a
+`.tmp.*` staging dir (skipped by readers, swept by GC), or a renamed final
+dir WITHOUT the manifest (also skipped) — never a torn snapshot that
+`latest_committed()`/`load_state_dict` would read.
+
+The manifest records step, world_size, the per-rank write-session nonces
+(the handshake that all ranks' bytes in the dir came from the SAME save),
+and a shard inventory with byte sizes + CRC32s, so `verify_snapshot` can
+reject bit rot or truncation without trusting the directory contents.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import time
+import warnings
+import zlib
+
+__all__ = [
+    "COMMIT_MARKER", "STAGING_SUFFIX", "CheckpointCorruptError", "CrcWriter",
+    "fsync_dir", "write_commit_marker", "read_commit_marker", "is_committed",
+    "is_staging_dir", "list_metadata_files", "verify_shard_file",
+    "verify_snapshot", "chaos_point",
+]
+
+COMMIT_MARKER = "COMMITTED"
+STAGING_SUFFIX = ".tmp"
+_FORMAT = "paddle_tpu-ckpt-v3"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A snapshot failed verification (missing/truncated/bit-rotted shard,
+    bad manifest). Loaders raise it BEFORE placing anything, and
+    `CheckpointManager.restore` falls back to the previous committed step."""
+
+
+class CrcWriter:
+    """File-like write proxy accumulating CRC32 + byte count in-stream.
+
+    `np.save` writes through it, so the recorded checksum is of the bytes
+    the writer INTENDED — disk corruption after the fact can never agree
+    with it."""
+
+    def __init__(self, f):
+        self._f = f
+        self.nbytes = 0
+        self.crc32 = 0
+
+    def write(self, b):
+        self.crc32 = zlib.crc32(b, self.crc32)
+        self.nbytes += len(b)
+        return self._f.write(b)
+
+
+def fsync_dir(path):
+    """Durably persist a directory's entries (the rename/create itself)."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_commit_marker(ckpt_dir, payload=None):
+    """Write the fsync'd COMMITTED manifest — the single commit point."""
+    from paddle_tpu.framework.io import atomic_write
+
+    doc = {"format": _FORMAT, "committed_at": time.time()}
+    if payload:
+        doc.update(payload)
+    atomic_write(os.path.join(ckpt_dir, COMMIT_MARKER),
+                 lambda f: json.dump(doc, f, indent=1), mode="w")
+    fsync_dir(ckpt_dir)
+    return doc
+
+
+def read_commit_marker(ckpt_dir):
+    """Parsed manifest dict, or None when absent/unparseable (torn dir)."""
+    try:
+        with open(os.path.join(ckpt_dir, COMMIT_MARKER)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return doc if doc.get("format") == _FORMAT else None
+
+
+def is_committed(ckpt_dir):
+    return read_commit_marker(ckpt_dir) is not None
+
+
+def is_staging_dir(name):
+    return STAGING_SUFFIX + "." in name or name.endswith(STAGING_SUFFIX)
+
+
+def list_metadata_files(ckpt_dir):
+    return sorted(glob.glob(os.path.join(ckpt_dir, "metadata*.json")))
+
+
+def verify_shard_file(ckpt_dir, sm, deep=True):
+    """Verify ONE shard file against its recorded size/CRC32.
+
+    Raises CheckpointCorruptError naming the file. `deep=False` checks
+    existence + byte size only (cheap pre-flight); `deep=True` re-reads the
+    bytes and compares the CRC — catches bit rot a size check cannot."""
+    fpath = os.path.join(ckpt_dir, sm.file)
+    if not os.path.isfile(fpath):
+        raise CheckpointCorruptError(
+            f"checkpoint {ckpt_dir}: shard file {sm.file!r} is missing")
+    nbytes = getattr(sm, "nbytes", None)
+    if nbytes is not None:
+        actual = os.path.getsize(fpath)
+        if actual != nbytes:
+            raise CheckpointCorruptError(
+                f"checkpoint {ckpt_dir}: shard file {sm.file!r} is "
+                f"{actual} bytes, expected {nbytes} (truncated or torn "
+                "write)")
+    crc = getattr(sm, "crc32", None)
+    if deep and crc is not None:
+        got = 0
+        with open(fpath, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                got = zlib.crc32(chunk, got)
+        if got != crc:
+            raise CheckpointCorruptError(
+                f"checkpoint {ckpt_dir}: shard file {sm.file!r} CRC32 "
+                f"mismatch (recorded {crc:#010x}, on disk {got:#010x}) — "
+                "bit rot or a torn write")
+
+
+def verify_snapshot(ckpt_dir, deep=False):
+    """Verify a snapshot end to end; returns the manifest dict.
+
+    Checks: COMMITTED manifest parses; metadata files exist; every shard
+    in the merged metadata passes `verify_shard_file`; every file in the
+    manifest's inventory exists with the recorded size."""
+    from paddle_tpu.distributed.checkpoint.metadata import Metadata
+
+    marker = read_commit_marker(ckpt_dir)
+    if marker is None:
+        raise CheckpointCorruptError(
+            f"checkpoint {ckpt_dir}: no valid {COMMIT_MARKER} manifest "
+            "(uncommitted or torn snapshot)")
+    meta_files = list_metadata_files(ckpt_dir)
+    if not meta_files:
+        raise CheckpointCorruptError(
+            f"checkpoint {ckpt_dir}: committed but has no metadata*.json")
+    world = marker.get("world_size")
+    if world is not None and len(meta_files) != world:
+        raise CheckpointCorruptError(
+            f"checkpoint {ckpt_dir}: manifest says world_size={world} but "
+            f"{len(meta_files)} metadata files are present (a rank's "
+            "metadata is missing)")
+    for fname, rec in (marker.get("inventory") or {}).items():
+        fpath = os.path.join(ckpt_dir, fname)
+        if not os.path.isfile(fpath):
+            raise CheckpointCorruptError(
+                f"checkpoint {ckpt_dir}: inventory file {fname!r} is "
+                "missing")
+        want = rec.get("nbytes")
+        if want is not None and os.path.getsize(fpath) != want:
+            raise CheckpointCorruptError(
+                f"checkpoint {ckpt_dir}: inventory file {fname!r} is "
+                f"{os.path.getsize(fpath)} bytes, expected {want}")
+    md = Metadata.load_dir(ckpt_dir)
+    for tm in md.tensors.values():
+        for sm in tm.shards or []:
+            verify_shard_file(ckpt_dir, sm, deep=deep)
+    return marker
+
+
+# --------------------------------------------------------------------------
+# fault-injection seam (tools/chaos_inject.py)
+# --------------------------------------------------------------------------
+
+_warned_no_chaos = False
+
+
+def chaos_point(name, **ctx):
+    """No-op unless PADDLE_CHAOS is set; then delegates to the injector in
+    tools/chaos_inject.py, which may raise (fail_at/io_error) or hard-exit
+    the process (crash_at/kill_after) at this named fault point."""
+    if not os.environ.get("PADDLE_CHAOS"):
+        return
+    global _warned_no_chaos
+    try:
+        from tools.chaos_inject import get_injector
+    except ImportError:
+        if not _warned_no_chaos:
+            _warned_no_chaos = True
+            warnings.warn("PADDLE_CHAOS is set but tools.chaos_inject is "
+                          "not importable (repo root not on sys.path?); "
+                          "fault injection disabled", RuntimeWarning)
+        return
+    get_injector().point(name, **ctx)

@@ -16,7 +16,59 @@ import os
 
 import numpy as np
 
+from paddle_tpu.distributed.checkpoint.integrity import (
+    CheckpointCorruptError, is_committed, verify_shard_file)
 from paddle_tpu.distributed.checkpoint.metadata import Metadata, norm_index
+
+
+def _preflight(md, path, flat):
+    """Validate the checkpoint BEFORE placing anything: every shard file a
+    needed tensor references must exist (with its recorded byte size), its
+    dtype must parse, and the shard rectangles must stay in-bounds and
+    cover the tensor. A partial checkpoint fails here with the offending
+    shard named — not with a mid-load crash after half the state was
+    already replaced."""
+    missing = [k for k in flat if k not in md.tensors]
+    if missing:
+        raise ValueError(f"checkpoint at {path} is missing tensors "
+                         f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+    for name in flat:
+        tm = md.tensors[name]
+        try:
+            np.dtype(tm.dtype)
+        except TypeError as e:
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: tensor {name!r} has unparseable dtype "
+                f"{tm.dtype!r}") from e
+        if tm.shards is None:
+            # v1: one whole-tensor file
+            if not os.path.isfile(os.path.join(path, tm.file)):
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: tensor {name!r} file {tm.file!r} "
+                    "is missing")
+            continue
+        shape = tuple(tm.shape)
+        volume = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        covered = 0
+        for sm in tm.shards:
+            try:
+                verify_shard_file(path, sm, deep=False)
+            except CheckpointCorruptError as e:
+                raise CheckpointCorruptError(
+                    f"tensor {name!r}: {e}") from None
+            if (len(sm.offsets) != len(shape)
+                    or any(o < 0 or o + ln > d for o, ln, d
+                           in zip(sm.offsets, sm.lengths, shape))):
+                raise CheckpointCorruptError(
+                    f"checkpoint {path}: tensor {name!r} shard {sm.file!r} "
+                    f"rectangle offsets={sm.offsets} lengths={sm.lengths} "
+                    f"falls outside the saved shape {list(shape)}")
+            covered += int(np.prod(sm.lengths, dtype=np.int64)) if shape else 1
+        if covered < volume:
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: tensor {name!r} shards cover only "
+                f"{covered} of {volume} elements — a per-process shard "
+                "file is missing (partial/torn checkpoint)")
 
 
 def _assemble(block_index, shape, dtype, shards, ckpt_dir, cache):
@@ -51,8 +103,14 @@ def _assemble(block_index, shape, dtype, shards, ckpt_dir, cache):
 
 
 def load_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None, offload=False):
-    """Fill `state_dict`'s tensors in place from `path` (reshard-on-load)."""
+                    coordinator_rank=0, unique_id=None, offload=False,
+                    verify=False):
+    """Fill `state_dict`'s tensors in place from `path` (reshard-on-load).
+
+    Pre-flight validation always runs before anything is placed; `verify=
+    True` additionally re-reads every needed shard file and checks its
+    recorded CRC32 (catches bit rot a size check cannot — what
+    `CheckpointManager.restore` uses before trusting a snapshot)."""
     import jax
 
     from paddle_tpu.core.tensor import Tensor
@@ -60,11 +118,19 @@ def load_state_dict(state_dict, path, process_group=None,
         _flatten_state)
 
     md = Metadata.load_dir(path)
+    if md.version >= 3 and not is_committed(path):
+        # a v3 dir without its COMMITTED manifest is a torn snapshot (the
+        # crash window between rename and marker); pre-v3 dirs have no
+        # marker by construction and stay loadable
+        raise CheckpointCorruptError(
+            f"checkpoint {path} was never committed (missing COMMITTED "
+            "manifest) — refusing to load a possibly torn snapshot")
     flat = _flatten_state(state_dict)
-    missing = [k for k in flat if k not in md.tensors]
-    if missing:
-        raise ValueError(f"checkpoint at {path} is missing tensors {missing[:5]}"
-                         f"{'...' if len(missing) > 5 else ''}")
+    _preflight(md, path, flat)
+    if verify:
+        for name in flat:
+            for sm in md.tensors[name].shards or []:
+                verify_shard_file(path, sm, deep=True)
     for name, t in flat.items():
         tm = md.tensors[name]
         arr = t._data if isinstance(t, Tensor) else t

@@ -1,0 +1,311 @@
+"""CheckpointManager — async atomic step checkpoints with retention GC.
+
+Orbax-CheckpointManager-shaped orchestration over the hardened writer in
+`save_state_dict.py` (the reference framework has no equivalent; its
+fleet/elastic layer assumes an external checkpoint story):
+
+  - every `save(state, step)` stages into `step_N.tmp.<nonce>` and commits
+    via fsync(files) -> fsync(dir) -> atomic rename to `step_N` -> fsync'd
+    COMMITTED manifest (step, world_size, per-rank nonce handshake, shard
+    inventory with byte sizes) — the single commit point;
+  - device->host snapshot happens synchronously inside `save`, so training
+    can mutate donated buffers the moment it returns; the file writes run
+    on a background writer (single-process; multi-process degrades to sync
+    because the commit barrier is a device collective);
+  - write-once: a committed step is never rewritten;
+  - `latest_committed()` / `restore()` skip torn or partial dirs (staging
+    leftovers, renamed-but-unmarked dirs, manifest/CRC mismatches) and
+    fall back to the previous COMMITTED snapshot;
+  - retention GC keeps the newest `keep_last_k` committed steps and sweeps
+    orphaned staging dirs;
+  - writer errors surface on the returned handle (`.result()`), plus a
+    `checkpoint/*` counter/gauge family in the shared metrics registry.
+
+The elastic supervisor (`fleet/elastic`) exports `PADDLE_CHECKPOINT_DIR`
+into every (re)spawned trainer; `CheckpointManager()` with no `root` reads
+it, which is what turns a supervisor restart into a resume.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import secrets
+import shutil
+import sys
+import time
+import warnings
+
+import numpy as np
+
+from paddle_tpu.distributed.checkpoint.integrity import (
+    CheckpointCorruptError, chaos_point, is_committed, read_commit_marker,
+    verify_snapshot)
+from paddle_tpu.distributed.checkpoint.load_state_dict import load_state_dict
+from paddle_tpu.distributed.checkpoint.save_state_dict import (
+    _EXTRAS_FILE, AsyncSaveHandle, save_state_dict)
+
+__all__ = ["CheckpointManager"]
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_STAGING_RE = re.compile(r"^step_(\d+)\.tmp\.[0-9a-f]+$")
+
+
+class CheckpointManager:
+    def __init__(self, root=None, keep_last_k=3, async_save=True,
+                 coordinator_rank=0, registry=None):
+        if root is None:
+            root = os.environ.get("PADDLE_CHECKPOINT_DIR")
+        if not root:
+            raise ValueError(
+                "CheckpointManager needs a root directory: pass root= or "
+                "set PADDLE_CHECKPOINT_DIR (the elastic supervisor exports "
+                "it into every restarted trainer)")
+        self.root = os.path.normpath(os.fspath(root))
+        os.makedirs(self.root, exist_ok=True)
+        self.keep_last_k = int(keep_last_k) if keep_last_k else 0
+        self.async_save = bool(async_save)
+        self.coordinator_rank = coordinator_rank
+        if registry is None:
+            from paddle_tpu.observability.registry import global_registry
+
+            registry = global_registry()
+        self.registry = registry
+        self._handle = None       # last save's handle
+        self._last_error = None   # last FAILED save's error (also on handle)
+        self._warned_sync = False
+
+    # -- paths ---------------------------------------------------------------
+    def step_dir(self, step):
+        return os.path.join(self.root, f"step_{int(step)}")
+
+    def _list(self):
+        try:
+            return os.listdir(self.root)
+        except OSError:
+            return []
+
+    def committed_steps(self):
+        """Sorted steps whose dir carries a valid COMMITTED manifest that
+        agrees with the dir name; torn/partial dirs are skipped (counted)."""
+        steps = []
+        for name in self._list():
+            m = _STEP_RE.match(name)
+            if not m:
+                continue
+            step = int(m.group(1))
+            marker = read_commit_marker(os.path.join(self.root, name))
+            if marker is None or int(marker.get("step", step)) != step:
+                self.registry.inc("checkpoint/torn_dirs_skipped")
+                continue
+            steps.append(step)
+        return sorted(steps)
+
+    def latest_committed(self):
+        """(step, path) of the newest committed snapshot, or None."""
+        steps = self.committed_steps()
+        if not steps:
+            return None
+        return steps[-1], self.step_dir(steps[-1])
+
+    # back-compat spelling used by early elastic prototypes
+    def latest(self):
+        return self.latest_committed()
+
+    # -- save ----------------------------------------------------------------
+    def save(self, state_dict, step, extras=None, async_save=None):
+        """Stage + commit `state_dict` as `step_N`. Returns an
+        AsyncSaveHandle; `.result()` re-raises writer errors.
+
+        The device->host snapshot happens before this returns; the file
+        writes + commit run on the background writer (async) or inline
+        (sync / multi-process). Write-once: a committed `step` raises."""
+        import jax
+
+        step = int(step)
+        self.wait(swallow=True)  # one writer at a time, ordered commits
+        final = self.step_dir(step)
+        if is_committed(final):
+            raise RuntimeError(
+                f"checkpoint step {step} at {final} is already committed — "
+                "committed steps are write-once (use a new step number)")
+        use_async = self.async_save if async_save is None else bool(async_save)
+        world = jax.process_count()
+        if use_async and world > 1:
+            # save_state_dict would warn per call; decide here once
+            if not self._warned_sync:
+                self._warned_sync = True
+                warnings.warn(
+                    "CheckpointManager: async save degrades to sync under "
+                    "multi-process runs (the commit barrier is a device "
+                    "collective)", RuntimeWarning, stacklevel=2)
+            use_async = False
+        # per-rank nonce handshake: each rank draws a write-session nonce;
+        # rank 0's names the shared staging dir and ALL of them ride the
+        # manifest — a reader can tell every rank's bytes in this dir came
+        # from the same save session
+        nonce = secrets.randbits(63)
+        if world > 1:
+            from jax.experimental import multihost_utils
+
+            nonces = [int(x) for x in np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray([nonce], np.int64))).reshape(-1)]
+        else:
+            nonces = [nonce]
+        staging = f"{final}.tmp.{nonces[0]:016x}"
+        payload = {
+            "step": step,
+            "world_size": world,
+            "nonces": {str(r): f"{n:016x}" for r, n in enumerate(nonces)},
+        }
+        mgr_extras = {"step": step}
+        if extras:
+            mgr_extras.update(extras)
+
+        def _post_commit():
+            # coordinator-only, after the manifest landed (on the writer
+            # thread in async mode) — training is never blocked on GC
+            self.registry.set_gauge("checkpoint/last_committed_step", step)
+            self._gc(current=step)
+
+        handle = save_state_dict(
+            state_dict, final, coordinator_rank=self.coordinator_rank,
+            async_save=use_async, extras=mgr_extras, _staging=staging,
+            _commit_payload=payload, _post_commit=_post_commit,
+            _registry=self.registry)
+        if handle is None:
+            handle = AsyncSaveHandle(final)  # sync path: already complete
+        self._handle = handle
+        return handle
+
+    def wait(self, swallow=False):
+        """Block until the in-flight save (if any) finishes. Re-raises its
+        error unless `swallow=True` (then it is recorded + warned — the
+        error has already surfaced on that save's own handle)."""
+        h, self._handle = self._handle, None
+        if h is None:
+            return
+        try:
+            h.result()
+        except BaseException as e:
+            self._last_error = e
+            if not swallow:
+                raise
+            warnings.warn(
+                f"previous async checkpoint save to {h.path} failed: {e!r} "
+                "(the previous committed snapshot remains the latest)",
+                RuntimeWarning, stacklevel=3)
+
+    # -- restore -------------------------------------------------------------
+    def restore(self, state_dict, step=None, verify=True):
+        """Fill `state_dict` in place from the newest committed snapshot
+        (or an explicit `step`). Torn/corrupt snapshots are skipped with a
+        fallback to the previous COMMITTED one; returns the extras dict
+        (always carries 'step'). Raises FileNotFoundError when no
+        committed snapshot survives, CheckpointCorruptError when an
+        explicit `step` is bad."""
+        t0 = time.monotonic()
+        if step is not None:
+            candidates = [int(step)]
+            explicit = True
+        else:
+            candidates = list(reversed(self.committed_steps()))
+            explicit = False
+        if not candidates:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {self.root}")
+        last_exc = None
+        for i, s in enumerate(candidates):
+            path = self.step_dir(s)
+            try:
+                marker = verify_snapshot(path, deep=False)
+                load_state_dict(state_dict, path, verify=verify)
+                extras = self._read_extras(path, marker)
+                self.registry.inc("checkpoint/restores", labels={
+                    "result": "ok" if i == 0 else "fallback"})
+                self.registry.observe("checkpoint/restore_seconds",
+                                      time.monotonic() - t0)
+                extras.setdefault("step", s)
+                return extras
+            except (CheckpointCorruptError, OSError, ValueError) as e:
+                last_exc = e
+                if explicit:
+                    self.registry.inc("checkpoint/restores",
+                                      labels={"result": "failed"})
+                    raise
+                print(f"[checkpoint] snapshot step_{s} failed verification "
+                      f"({e}); falling back to the previous committed step",
+                      file=sys.stderr, flush=True)
+                # quarantine the bad snapshot: it must stop being "latest
+                # committed" (resume would loop on it forever) and its step
+                # number must become writable again — training continues
+                # from the previous step and will re-reach step s. The
+                # bytes survive aside for forensics until GC sweeps them.
+                try:
+                    os.replace(path, f"{path}.corrupt")
+                except OSError:
+                    pass
+                self.registry.inc("checkpoint/quarantined")
+        self.registry.inc("checkpoint/restores", labels={"result": "failed"})
+        raise CheckpointCorruptError(
+            f"every committed snapshot under {self.root} failed "
+            f"verification; last error: {last_exc}")
+
+    def resume(self, state_dict):
+        """`restore` if any committed snapshot exists, else None — the
+        supervisor-restart entry point: a fresh world calls this and either
+        continues from the newest COMMITTED step or starts from scratch."""
+        if self.latest_committed() is None:
+            return None
+        return self.restore(state_dict)
+
+    def _read_extras(self, path, marker):
+        import pickle
+        import zlib
+
+        from paddle_tpu.framework.io import _from_saveable
+
+        fpath = os.path.join(path, _EXTRAS_FILE)
+        if not os.path.isfile(fpath):
+            return {}
+        with open(fpath, "rb") as f:
+            blob = f.read()
+        want_crc = marker.get("extras_crc32")
+        if want_crc is not None and zlib.crc32(blob) != want_crc:
+            raise CheckpointCorruptError(
+                f"checkpoint {path}: extras.pkl CRC32 mismatch (bit rot in "
+                "the step/LR/RNG payload)")
+        out = _from_saveable(pickle.loads(blob))
+        return out if isinstance(out, dict) else {"extras": out}
+
+    # -- retention GC --------------------------------------------------------
+    def _gc(self, current=None):
+        """Coordinator-side sweep after a commit: drop committed steps
+        beyond keep_last_k (never `current`) and orphaned staging dirs of
+        OTHER steps/sessions (a crashed attempt's `step_N.tmp.<nonce>`)."""
+        if self.keep_last_k > 0:
+            steps = self.committed_steps()
+            for s in steps[:-self.keep_last_k]:
+                if s == current:
+                    continue
+                shutil.rmtree(self.step_dir(s), ignore_errors=True)
+                self.registry.inc("checkpoint/gc_removed",
+                                  labels={"kind": "step"})
+        for name in self._list():
+            full = os.path.join(self.root, name)
+            if _STAGING_RE.match(name) or name.endswith(".replaced"):
+                shutil.rmtree(full, ignore_errors=True)
+                self.registry.inc("checkpoint/gc_removed",
+                                  labels={"kind": "staging"})
+        chaos_point("after_gc")
+
+    def close(self):
+        self.wait(swallow=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

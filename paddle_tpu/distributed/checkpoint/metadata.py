@@ -6,7 +6,10 @@ destination shards).
 
 Format v2: every tensor is stored as one or more SHARD files, each covering
 a hyper-rectangle [offset, offset+length) of the global shape. v1 files
-(one whole-tensor .npy per tensor) still load.
+(one whole-tensor .npy per tensor) still load. v3 additionally records each
+shard file's byte length + in-stream CRC32 (filled by the committing
+writer, `integrity.CrcWriter`) so loaders can verify integrity before
+placing anything; v2 files (no sizes) still load.
 """
 
 from __future__ import annotations
@@ -25,6 +28,9 @@ class ShardMetadata:
     file: str
     offsets: List[int]   # global start per dim
     lengths: List[int]   # extent per dim
+    # v3: filled in-stream by the writer; None in pre-v3 checkpoints
+    nbytes: Optional[int] = None
+    crc32: Optional[int] = None
 
 
 def norm_index(index, shape):
@@ -60,15 +66,19 @@ class TensorMetadata:
 @dataclasses.dataclass
 class Metadata:
     tensors: Dict[str, TensorMetadata] = dataclasses.field(default_factory=dict)
-    version: int = 2
+    version: int = 3
 
     def dump(self, path):
-        with open(path, "w") as f:
-            json.dump({
-                "version": self.version,
-                "tensors": {k: dataclasses.asdict(v)
-                            for k, v in self.tensors.items()},
-            }, f, indent=1)
+        from paddle_tpu.framework.io import atomic_write
+
+        # atomic + fsync'd: the commit protocol renames the whole staging
+        # dir, but the metadata file itself must also never be torn (a
+        # crashed legacy save would otherwise leave a half-written JSON)
+        atomic_write(path, lambda f: json.dump({
+            "version": self.version,
+            "tensors": {k: dataclasses.asdict(v)
+                        for k, v in self.tensors.items()},
+        }, f, indent=1), mode="w")
 
     @staticmethod
     def load(path):

@@ -12,24 +12,48 @@ metadata records the covering hyper-rectangle. Multi-host: every process
 writes only its addressable shards + its own metadata file
 (`Metadata.load_dir` merges). Async save snapshots device->host first,
 then writes on a thread.
+
+Crash safety (the commit protocol, `integrity.py`): all writes land in a
+staging dir with per-shard CRC32 + byte length recorded in the metadata;
+shard writes retry with backoff on transient IO errors; after a
+cross-process vote the coordinator fsyncs, renames staging -> final and
+writes the fsync'd `COMMITTED` manifest. A kill -9 at ANY point therefore
+leaves either the previous snapshot intact or a staging dir that
+`latest_committed()`/loaders skip — never a torn "newest" checkpoint.
+`CheckpointManager` (manager.py) drives this same writer with a
+per-step nonce'd staging dir and a step/world_size/inventory manifest.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 import threading
+import time
+import warnings
 
 import numpy as np
 
+from paddle_tpu.distributed.checkpoint.integrity import (
+    STAGING_SUFFIX, CrcWriter, chaos_point, fsync_dir, write_commit_marker)
 from paddle_tpu.distributed.checkpoint.metadata import (
     _META_FILE, Metadata, ShardMetadata, TensorMetadata, norm_index)
 
-__all__ = ["save_state_dict", "_flatten_state", "_META_FILE"]
+__all__ = ["save_state_dict", "AsyncSaveHandle", "_flatten_state",
+           "_META_FILE"]
+
+_EXTRAS_FILE = "extras.pkl"
+
+# in-process registry of snapshot paths with a live writer: a second save
+# to the same path would rmtree the first's staging dir mid-write and the
+# interleaved files could COMMIT as a corrupt snapshot — the one artifact
+# the protocol exists to prevent. (Cross-process same-path races are the
+# caller's contract, as in the reference.)
+_ACTIVE_SAVES = set()
+_ACTIVE_LOCK = threading.Lock()
 
 
 def _flatten_state(state_dict, prefix=""):
-    from paddle_tpu.core.tensor import Tensor
-
     flat = {}
     for k, v in state_dict.items():
         name = f"{prefix}{k}"
@@ -61,69 +85,426 @@ def _offsets_lengths(index, shape):
     return starts, [b - a for a, b in zip(starts, stops)]
 
 
+def _write_npy(fpath, host):
+    """Write ONE shard file, returning (nbytes, crc32) of the bytes as
+    intended by the writer (computed in-stream, so disk corruption after
+    the fact can never agree with the recorded checksum).
+
+    This is the fault-injection seam: `tools/chaos_inject.py` fires at the
+    `shard_write` point (io_error / fail_at / crash_at).
+    """
+    chaos_point("shard_write", path=fpath)
+    with open(fpath, "wb") as f:
+        w = CrcWriter(f)
+        np.save(w, host)
+        f.flush()
+        os.fsync(f.fileno())
+    return w.nbytes, w.crc32
+
+
+def _write_npy_retry(fpath, host, attempts=None, base_delay=0.05,
+                     registry=None):
+    """Retry transient IO errors with exponential backoff: one EIO/ENOSPC
+    blip on a network filesystem must not abort the whole snapshot. The
+    last failure propagates — a filesystem that is truly gone still fails
+    loudly (and the commit never happens)."""
+    if attempts is None:
+        attempts = int(os.environ.get("PADDLE_CKPT_IO_RETRIES", "3"))
+    attempts = max(1, attempts)
+    for i in range(attempts):
+        try:
+            return _write_npy(fpath, host)
+        except OSError:
+            if i == attempts - 1:
+                raise
+            if registry is not None:
+                registry.inc("checkpoint/write_retries")
+            time.sleep(base_delay * (2 ** i))
+
+
+def _all_ranks_ok(local_ok):
+    """All-ranks AND of each process's write success (doubles as the
+    pre-commit barrier). A rank whose shard write failed still REACHES
+    this point, so its peers learn of the failure instead of hanging in a
+    barrier that rank will never enter; True trivially in single-process
+    runs."""
+    import jax
+
+    if jax.process_count() == 1:
+        return local_ok
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([1 if local_ok else 0], np.int32))
+    return bool(np.asarray(flags).min())
+
+
+class AsyncSaveHandle:
+    """Joinable handle for an async save (reference async_save's bare
+    daemon Thread silently lost writer exceptions — VERDICT-class bug).
+
+    `.result()` blocks until the writer finishes and RE-RAISES anything it
+    raised; `.done()` polls. `.join()` survives as a deprecated alias of
+    `.result()` for code that treated the return value as a Thread.
+    """
+
+    def __init__(self, path):
+        self.path = path
+        self._thread = None
+        self._error = None
+
+    def _run(self, fn):
+        try:
+            fn()
+        except BaseException as e:  # surfaced by .result(), never swallowed
+            self._error = e
+
+    def _start(self, fn):
+        self._thread = threading.Thread(
+            target=self._run, args=(fn,), daemon=True)
+        self._thread.start()
+
+    def _run_sync(self, fn):
+        """Run the writer inline; the handle still carries its error so
+        callers polling .result() see a uniform interface."""
+        self._run(fn)
+
+    def done(self):
+        return self._thread is None or not self._thread.is_alive()
+
+    def result(self, timeout=None):
+        """Wait for the save; re-raise the writer's exception if it died.
+        Returns the final snapshot path on success."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(
+                    f"async checkpoint save to {self.path} still running "
+                    f"after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self.path
+
+    def join(self, timeout=None):
+        """Thread-compatible alias: a timeout expiring returns None (like
+        Thread.join) instead of raising, but a FINISHED writer's error is
+        re-raised rather than silently lost."""
+        warnings.warn(
+            "AsyncSaveHandle.join() is deprecated — use .result(), which "
+            "re-raises writer exceptions instead of losing them",
+            DeprecationWarning, stacklevel=2)
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                return None  # Thread.join semantics for legacy pollers
+        if self._error is not None:
+            raise self._error
+        return None
+
+
 def save_state_dict(state_dict, path, process_group=None, coordinator_rank=0,
-                    unique_id=None, async_save=False):
-    """reference save_state_dict (`save_state_dict.py:135`)."""
+                    unique_id=None, async_save=False, extras=None,
+                    _staging=None, _commit_payload=None, _post_commit=None,
+                    _registry=None):
+    """reference save_state_dict (`save_state_dict.py:135`) + atomic commit.
+
+    `extras`: optional picklable dict (step, LR, RNG state, ...) written by
+    the coordinator as `extras.pkl` inside the snapshot before commit —
+    what `CheckpointManager.resume()` hands back.
+
+    The underscore kwargs are `CheckpointManager`'s hooks into this (the
+    single) writer: `_staging` overrides the staging dir (the manager's
+    nonce'd `step_N.tmp.<nonce>`), `_commit_payload` rides the COMMITTED
+    manifest (step/world_size/nonces), `_post_commit` runs on the
+    coordinator after a successful commit (retention GC, metric gauges),
+    `_registry` routes the checkpoint/* metrics into the manager's
+    registry instead of the process-global one.
+    """
     import jax
 
     from paddle_tpu.core.tensor import Tensor
 
-    os.makedirs(path, exist_ok=True)
+    if _registry is not None:
+        registry = _registry
+    else:
+        from paddle_tpu.observability.registry import global_registry
+
+        registry = global_registry()
+    path = os.path.normpath(path)
+    staging = os.path.normpath(_staging) if _staging else path + STAGING_SUFFIX
     pidx = jax.process_index()
-    flat = _flatten_state(state_dict)
-    md = Metadata()
-    writes = []  # (fpath, host ndarray)
-    for name, t in flat.items():
-        arr = t._data if isinstance(t, Tensor) else t
-        safe = name.replace("/", "_")
-        if isinstance(arr, jax.Array) and arr.sharding is not None:
-            gshape = tuple(arr.shape)
-            mesh_shape, mesh_axes, pspec = _sharding_info(arr)
-            shards_md = []
-            seen = set()
-            for j, sh in enumerate(arr.addressable_shards):
-                if sh.replica_id != 0:
-                    # exactly one device globally holds replica 0 of each
-                    # block: that process writes it (multi-host runs would
-                    # otherwise write world_size copies of every replicated
-                    # tensor)
-                    continue
-                offs, lens = _offsets_lengths(sh.index, gshape)
-                key = tuple(offs) + tuple(lens)
-                if key in seen:
-                    continue
-                seen.add(key)
-                fname = f"{safe}.{pidx}.{len(shards_md)}.npy"
-                # device->host of the LOCAL shard only — never the logical
-                # tensor (the r2 save gathered it all; VERDICT item 2)
-                host = np.asarray(sh.data)
-                shards_md.append(ShardMetadata(
-                    file=fname, offsets=offs, lengths=lens))
-                writes.append((os.path.join(path, fname), host))
-            md.tensors[name] = TensorMetadata(
-                name=name, shape=list(gshape), dtype=str(arr.dtype),
-                shards=shards_md, mesh_shape=mesh_shape,
-                mesh_axes=mesh_axes, partition_spec=pspec)
+    t_begin = time.monotonic()
+    # register BEFORE touching staging: the rmtree below must never hit a
+    # dir a live same-process writer is filling (see _ACTIVE_SAVES).
+    # Captured (not raised) so this rank still reaches the setup vote —
+    # raising here would strand multi-host peers in their barrier.
+    reg_err = None
+    with _ACTIVE_LOCK:
+        if path in _ACTIVE_SAVES:
+            reg_err = RuntimeError(
+                f"a save to {path} is already in flight in this process — "
+                "wait on its handle (.result()) before saving the same "
+                "snapshot again")
         else:
-            host = np.asarray(arr)
-            fname = f"{safe}.{pidx}.0.npy"
-            md.tensors[name] = TensorMetadata(
-                name=name, shape=list(host.shape), dtype=str(host.dtype),
-                shards=[ShardMetadata(file=fname,
-                                      offsets=[0] * host.ndim,
-                                      lengths=list(host.shape))])
-            writes.append((os.path.join(path, fname), host))
+            _ACTIVE_SAVES.add(path)
+    owned = False  # flips once _guarded_write assumes unregistration
 
-    meta_name = _META_FILE if pidx == 0 else f"metadata.{pidx}.json"
+    def _unregister():
+        with _ACTIVE_LOCK:
+            _ACTIVE_SAVES.discard(path)
 
-    def _write():
-        for fpath, host in writes:
-            np.save(fpath, host)
-        md.dump(os.path.join(path, meta_name))
+    if reg_err is None and pidx == coordinator_rank and os.path.isdir(staging):
+        # leftover of a previous crashed save attempt for this step
+        # (ignore_errors: cannot raise, so the vote below stays aligned)
+        shutil.rmtree(staging, ignore_errors=True)
+    # multi-host (shared-FS, like the reference's distributed save): the
+    # vote doubles as the begin barrier — nobody writes until every
+    # rank's registration + staging cleanup succeeded
+    if not _all_ranks_ok(reg_err is None):
+        if reg_err is not None:
+            raise reg_err
+        _unregister()
+        raise RuntimeError(
+            f"a peer rank failed checkpoint setup for {path}")
+    try:
+        os.makedirs(staging, exist_ok=True)
+        flat = _flatten_state(state_dict)
+        md = Metadata()
+        writes = []  # (fpath, host ndarray, ShardMetadata to fill with crc)
+        for name, t in flat.items():
+            arr = t._data if isinstance(t, Tensor) else t
+            safe = name.replace("/", "_")
+            if isinstance(arr, jax.Array) and arr.sharding is not None:
+                gshape = tuple(arr.shape)
+                mesh_shape, mesh_axes, pspec = _sharding_info(arr)
+                shards_md = []
+                seen = set()
+                for j, sh in enumerate(arr.addressable_shards):
+                    if sh.replica_id != 0:
+                        # exactly one device globally holds replica 0 of each
+                        # block: that process writes it (multi-host runs would
+                        # otherwise write world_size copies of every replicated
+                        # tensor)
+                        continue
+                    offs, lens = _offsets_lengths(sh.index, gshape)
+                    key = tuple(offs) + tuple(lens)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    fname = f"{safe}.{pidx}.{len(shards_md)}.npy"
+                    # device->host of the LOCAL shard only — never the logical
+                    # tensor (the r2 save gathered it all; VERDICT item 2).
+                    # The host snapshot happens HERE, before save_state_dict
+                    # returns, so async callers may keep training (and
+                    # mutating donated buffers) immediately.
+                    host = np.asarray(sh.data)
+                    sm = ShardMetadata(file=fname, offsets=offs, lengths=lens)
+                    shards_md.append(sm)
+                    writes.append((os.path.join(staging, fname), host, sm))
+                md.tensors[name] = TensorMetadata(
+                    name=name, shape=list(gshape), dtype=str(arr.dtype),
+                    shards=shards_md, mesh_shape=mesh_shape,
+                    mesh_axes=mesh_axes, partition_spec=pspec)
+            else:
+                # np.array, not asarray: a plain-ndarray leaf would
+                # otherwise alias the caller's LIVE buffer, and an async
+                # writer would serialize post-mutation bytes (with a
+                # matching CRC — silent corruption). The jax branch above
+                # is safe: np.asarray(shard.data) already materializes a
+                # fresh host copy.
+                host = np.array(arr)
+                fname = f"{safe}.{pidx}.0.npy"
+                sm = ShardMetadata(file=fname, offsets=[0] * host.ndim,
+                                   lengths=list(host.shape))
+                md.tensors[name] = TensorMetadata(
+                    name=name, shape=list(host.shape), dtype=str(host.dtype),
+                    shards=[sm])
+                writes.append((os.path.join(staging, fname), host, sm))
 
-    if async_save:
-        th = threading.Thread(target=_write, daemon=True)
-        th.start()
-        return th
-    _write()
-    return None
+        meta_name = _META_FILE if pidx == 0 else f"metadata.{pidx}.json"
+        is_coord = pidx == coordinator_rank
+
+        extras_sig = {}  # filled below, recorded in the commit marker
+        extras_blob = None
+        if is_coord and extras is not None:
+            import pickle
+            import zlib
+
+            from paddle_tpu.framework.io import _to_saveable
+
+            # serialize extras NOW, not on the writer thread: the caller
+            # may advance its RNG/LR objects the moment save() returns,
+            # and a late pickle would pair step-N params with step-N+1
+            # extras. The checksum rides the commit marker (extras has no
+            # shard-metadata entry); bit rot in the pickled step/LR/RNG
+            # payload must not resume silently wrong.
+            extras_blob = pickle.dumps(_to_saveable(extras), protocol=4)
+            extras_sig.update(extras_crc32=zlib.crc32(extras_blob),
+                              extras_nbytes=len(extras_blob))
+
+        def _write():
+            err = None
+            try:
+                for fpath, host, sm in writes:
+                    sm.nbytes, sm.crc32 = _write_npy_retry(
+                        fpath, host, registry=registry)
+                chaos_point("after_shards")
+                md.dump(os.path.join(staging, meta_name))
+                if extras_blob is not None:
+                    from paddle_tpu.framework.io import atomic_write
+
+                    atomic_write(os.path.join(staging, _EXTRAS_FILE),
+                                 lambda f: f.write(extras_blob))
+                chaos_point("after_metadata")
+            except BaseException as e:
+                # do NOT bail yet: this rank must still reach the vote below
+                # or its peers hang forever waiting for it
+                err = e
+            # every rank's shards + metadata must be durably in staging before
+            # anyone commits — and every rank must agree the writes SUCCEEDED
+            # (the vote doubles as the barrier)
+            all_ok = _all_ranks_ok(err is None)
+            if err is not None:
+                registry.inc("checkpoint/saves", labels={"result": "failed"})
+                raise err
+            if not all_ok:
+                registry.inc("checkpoint/saves", labels={"result": "failed"})
+                raise RuntimeError(
+                    f"a peer rank failed its checkpoint write; snapshot {path} "
+                    "was NOT committed (previous committed snapshot remains "
+                    "the latest)")
+            commit_err = None
+            if is_coord:
+                try:
+                    _commit()
+                except BaseException as e:
+                    # still reach the commit vote below: peers must learn the
+                    # commit failed rather than hang waiting for this rank
+                    commit_err = e
+            # the vote doubles as the commit barrier; every rank learns
+            # whether the marker actually landed
+            if not _all_ranks_ok(commit_err is None):
+                registry.inc("checkpoint/saves", labels={"result": "failed"})
+                if commit_err is not None:
+                    raise commit_err
+                raise RuntimeError(
+                    f"coordinator failed to commit snapshot {path}; the "
+                    "previous committed snapshot remains the latest")
+            registry.inc("checkpoint/saves", labels={"result": "committed"})
+            registry.inc("checkpoint/bytes_written",
+                         sum(sm.nbytes or 0 for _, _, sm in writes))
+            registry.observe("checkpoint/save_seconds",
+                             time.monotonic() - t_begin)
+            if is_coord and _post_commit is not None:
+                _post_commit()
+
+        def _commit():
+            from paddle_tpu.distributed.checkpoint.integrity import (
+                is_committed, list_metadata_files)
+
+            old = None
+            if os.path.isdir(path):
+                looks_like_ckpt = (is_committed(path)
+                                   or list_metadata_files(path))
+                if looks_like_ckpt:
+                    # re-saving the same step (fallback-then-retrain), or
+                    # overwriting a pre-v3 checkpoint (valid but marker-less):
+                    # move the old dir ASIDE first, delete it only after the
+                    # new one is committed — a kill anywhere in this window
+                    # leaves the old bytes (recoverable at `step-N.replaced`)
+                    # or the new snapshot, never neither
+                    old = path + ".replaced"
+                    if is_committed(old) and not is_committed(path):
+                        # a previous re-save died between rename and marker:
+                        # the aside dir ALREADY holds this step's only
+                        # committed copy and `path` is its uncommitted
+                        # leftover — keep the aside, drop the leftover
+                        shutil.rmtree(path)
+                    else:
+                        shutil.rmtree(old, ignore_errors=True)
+                        os.replace(path, old)
+                else:
+                    # no metadata at all: the commit protocol never produces
+                    # such a dir (a renamed staging dir always carries
+                    # metadata), so this is somebody else's data — refuse
+                    # loudly rather than destroy it. An empty dir is fine to
+                    # take over.
+                    try:
+                        os.rmdir(path)
+                    except OSError:
+                        raise FileExistsError(
+                            f"checkpoint target {path} is an existing "
+                            "non-empty directory that does not look like "
+                            "a snapshot (no metadata*.json); refusing to "
+                            "overwrite it")
+            # durable-entries -> atomic-rename -> durable-rename -> marker:
+            # the exact order the recovery argument depends on
+            fsync_dir(staging)
+            chaos_point("before_rename")
+            os.replace(staging, path)
+            chaos_point("after_rename")
+            parent = os.path.dirname(os.path.abspath(path))
+            fsync_dir(parent)
+            payload = {"coordinator": pidx, **extras_sig}
+            if _commit_payload:
+                payload.update(_commit_payload)
+            # shard inventory with sizes: merged from EVERY rank's metadata
+            # (all durably in the dir — the write vote passed), so the
+            # manifest alone can expose truncation/missing files without
+            # trusting the directory contents
+            merged = Metadata.load_dir(path)
+            payload["inventory"] = {
+                sm.file: {"nbytes": sm.nbytes, "crc32": sm.crc32}
+                for tm in merged.tensors.values()
+                for sm in tm.shards or []}
+            write_commit_marker(path, payload)
+            chaos_point("after_commit")
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
+
+        def _guarded_write():
+            try:
+                _write()
+            finally:
+                _unregister()
+
+        requested_async = async_save
+        if async_save and jax.process_count() > 1:
+            # multi-host async would run the commit barrier (a device
+            # collective) on the writer thread, racing the main thread's
+            # train-step collectives — XLA requires one enqueue order
+            # across processes. Until the commit handshake is host-side
+            # (CheckFreq does a two-phase host protocol), degrade loudly
+            # to sync.
+            warnings.warn(
+                "async_save is not supported under multi-process runs yet "
+                "(the commit barrier is a device collective); saving "
+                "synchronously", RuntimeWarning, stacklevel=2)
+            async_save = False
+        if async_save:
+            handle = AsyncSaveHandle(path)
+            # ownership flips only once start() SUCCEEDED: a failed
+            # Thread.start must fall through to the finally below, or the
+            # path stays registered forever
+            handle._start(_guarded_write)
+            owned = True
+            return handle
+        owned = True
+        _guarded_write()
+        # sync-from-async degrade returns an already-completed handle so
+        # async callers' .result()/.done() bookkeeping still works
+        return AsyncSaveHandle(path) if requested_async else None
+    except BaseException:
+        if not owned:
+            # a failure between the setup vote and _write's vote (plan,
+            # makedirs, thread start): peers sit at their WRITE vote —
+            # tell them we failed instead of stranding them. (Past
+            # ownership, _write itself runs the votes.)
+            try:
+                _all_ranks_ok(False)
+            except Exception:
+                pass
+        raise
+    finally:
+        if not owned:
+            _unregister()

@@ -20,12 +20,14 @@ from __future__ import annotations
 
 import contextlib
 import os
+import random
 import sys
 import threading
 import time
 
-__all__ = ["CommMonitor", "start_comm_monitor", "get_comm_monitor",
-           "stop_comm_monitor", "guard"]
+__all__ = ["CommMonitor", "RankFailure", "start_comm_monitor",
+           "get_comm_monitor", "stop_comm_monitor", "guard",
+           "retry_store_op"]
 
 _monitor = None
 
@@ -34,10 +36,41 @@ class RankFailure(RuntimeError):
     pass
 
 
+def retry_store_op(fn, attempts=4, base_delay=0.05, max_delay=1.0,
+                   jitter=0.5, sleep=time.sleep, deadline=None):
+    """Run a store get/set with exponential backoff + jitter.
+
+    One slow KV op (store GC pause, TCP retransmit, an overloaded master)
+    must not be read as a dead peer: transient failures are retried
+    `attempts` times with delays base*2^i capped at `max_delay`, each
+    stretched by up to `jitter` randomly (so a thundering herd of retrying
+    ranks decorrelates). The LAST failure propagates — a store that is
+    truly gone still fails loudly, just not on the first hiccup.
+
+    `deadline` (time.monotonic()) hard-stops retrying: the first attempt
+    always runs, but no retry starts past it — callers with their own
+    cadence to keep (the heartbeat loop) bound a whole sweep this way.
+    """
+    attempts = max(1, attempts)  # 0/negative must still call fn once
+    for i in range(attempts):
+        try:
+            return fn()
+        except Exception:
+            delay = min(max_delay, base_delay * (2 ** i)) * (
+                1.0 + random.random() * jitter)
+            # a retry must not START past the deadline — account for the
+            # backoff sleep itself, not just time already spent
+            out_of_time = (deadline is not None
+                           and time.monotonic() + delay >= deadline)
+            if i == attempts - 1 or out_of_time:
+                raise
+            sleep(delay)
+
+
 class CommMonitor:
     def __init__(self, store, rank, world_size, heartbeat_interval=1.0,
                  miss_limit=5, on_failure=None, collective_timeout=300.0,
-                 registry=None):
+                 registry=None, store_retries=4):
         from paddle_tpu.core import native
         from paddle_tpu.observability.registry import global_registry
 
@@ -47,7 +80,9 @@ class CommMonitor:
         self.interval = heartbeat_interval
         self.miss_limit = miss_limit
         self.collective_timeout = collective_timeout
+        self.store_retries = store_retries
         self.failed_ranks = set()
+        self.stale_ages = {}  # rank -> heartbeat age (s) when declared dead
         # per-rank heartbeat-age gauges land in the shared telemetry
         # registry, where TrainingMonitor.heartbeat_ages() reads them back
         self.registry = registry if registry is not None else global_registry()
@@ -69,6 +104,10 @@ class CommMonitor:
                f"(failed so far: {sorted(self.failed_ranks) or 'none'})")
         self._timeouts.append(name)
         self.registry.inc("comm/watchdog_timeouts", labels={"op": name})
+        # fault history for --telemetry-out artifacts: one counter family
+        # across every failure kind, not just per-op timeout counts
+        self.registry.inc("fault_events",
+                          labels={"kind": "watchdog_timeout"})
         print(msg, file=sys.stderr, flush=True)
 
     @contextlib.contextmanager
@@ -94,11 +133,25 @@ class CommMonitor:
         grace = self.miss_limit * self.interval
         while not self._stop.is_set():
             try:
-                self.store.set(f"hb/{self.rank}", repr(time.time()))
+                # retried with backoff: a transiently slow store must not
+                # make THIS rank look dead to its peers — but bounded to
+                # half an interval, because a LONG set retry delays the
+                # next write and starves our own cadence just the same
+                retry_store_op(
+                    lambda: self.store.set(f"hb/{self.rank}",
+                                           repr(time.time())),
+                    attempts=self.store_retries,
+                    max_delay=self.interval / 2,
+                    deadline=time.monotonic() + self.interval / 2)
             except Exception:
                 pass  # the store itself died; peers will notice us missing
             self.registry.set_gauge("comm/heartbeat_age_s", 0.0,
                                     labels={"rank": self.rank})
+            # the whole peer sweep shares ONE interval of retry budget: a
+            # store brownout must not stretch the pass (and so THIS rank's
+            # next heartbeat write) past peers' grace window — a skipped
+            # read cycle is harmless, a starved own-heartbeat is not
+            round_deadline = time.monotonic() + self.interval
             for r in range(self.world_size):
                 if r == self.rank:
                     continue
@@ -113,7 +166,14 @@ class CommMonitor:
                         labels={"rank": r})
                     continue
                 try:
-                    val = self.store.get(f"hb/{r}", timeout=0.5)
+                    # same backoff on reads: a slow get is NOT a missed
+                    # heartbeat — only an ADVANCING-payload test (below)
+                    # may declare a peer dead
+                    val = retry_store_op(
+                        lambda: self.store.get(f"hb/{r}", timeout=0.5),
+                        attempts=self.store_retries,
+                        max_delay=self.interval / 2,
+                        deadline=round_deadline)
                 except Exception:
                     val = None
                 now = time.monotonic()
@@ -143,7 +203,9 @@ class CommMonitor:
         if r in self.failed_ranks:
             return
         self.failed_ranks.add(r)
+        self.stale_ages[r] = stale
         self.registry.inc("comm/ranks_declared_dead")
+        self.registry.inc("fault_events", labels={"kind": "dead_rank"})
         msg = (f"[comm-monitor] rank {self.rank}: rank {r} missed "
                f"heartbeats for {stale:.1f}s — declaring it DEAD")
         print(msg, file=sys.stderr, flush=True)
@@ -153,9 +215,13 @@ class CommMonitor:
     def check_peers(self):
         """Raise if any peer has been declared dead (call between steps)."""
         if self.failed_ranks:
+            ages = ", ".join(
+                f"rank {r} last heartbeat {self.stale_ages.get(r, 0):.1f}s "
+                "stale" for r in sorted(self.failed_ranks))
             raise RankFailure(
                 f"rank(s) {sorted(self.failed_ranks)} are dead "
-                f"(no heartbeat); aborting per failure-detection policy")
+                f"(no heartbeat): {ages}; aborting per failure-detection "
+                "policy")
 
     def stop(self):
         self._stop.set()

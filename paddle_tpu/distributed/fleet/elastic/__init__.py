@@ -26,7 +26,7 @@ import threading
 import time
 
 __all__ = ["ElasticStatus", "ElasticLevel", "ElasticManager",
-           "ElasticSupervisor"]
+           "ElasticSupervisor", "WorldSupervisor"]
 
 
 class ElasticStatus(enum.Enum):
@@ -164,11 +164,18 @@ class ElasticManager:
 class ElasticSupervisor:
     """Launch-side watcher (reference launch/controllers/watcher.py +
     elastic restart loop): run the trainer as a subprocess, restart it on
-    failure or scale events up to max_restarts."""
+    failure or scale events up to max_restarts.
+
+    `checkpoint_dir` turns restart into RESUME: the supervisor exports
+    `PADDLE_CHECKPOINT_DIR` into every (re)spawned trainer, and a trainer
+    that opens `CheckpointManager()` (no args) and calls `.resume(state)`
+    picks up training from the newest committed snapshot instead of from
+    step 0 — the restart loop and the checkpoint layer meet here.
+    """
 
     def __init__(self, cmd, env=None, env_fn=None, max_restarts=3,
                  manager=None, poll_interval=0.5, log=print, log_dir=None,
-                 rank=0):
+                 rank=0, checkpoint_dir=None):
         self.cmd = cmd
         self.env = env
         # env_fn(manager) -> env dict, evaluated at EVERY (re)spawn so a
@@ -182,9 +189,13 @@ class ElasticSupervisor:
         self.log = log
         self.log_dir = log_dir
         self.rank = rank
+        self.checkpoint_dir = checkpoint_dir
 
     def _spawn(self):
         env = self.env_fn(self.manager) if self.env_fn is not None else self.env
+        if self.checkpoint_dir is not None:
+            env = dict(os.environ if env is None else env)
+            env["PADDLE_CHECKPOINT_DIR"] = self.checkpoint_dir
         if self.log_dir:
             # per-rank log files (reference launch/job/container.py): each
             # attempt appends, stdout+stderr interleaved
@@ -244,3 +255,133 @@ class ElasticSupervisor:
                     self.manager.exit(completed=False)
                 return 1
             self.log(f"[elastic] restart {self.restarts}/{self.max_restarts}")
+
+
+def _free_port(host="127.0.0.1"):
+    import socket
+
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class WorldSupervisor:
+    """Whole-world fault-tolerant launcher: spawn every rank of a (single
+    host) world, watch for ANY rank dying, kill the survivors, and restart
+    the complete world against a fresh rendezvous — the
+    detect -> kill survivors -> restart -> restore loop the reference's
+    launch watcher + elastic manager implement across nodes.
+
+    Detection is two-level and composes with `comm_monitor`: the
+    supervisor sees the first dead rank's exit within `poll_interval`;
+    meanwhile the SURVIVING ranks' heartbeat monitors declare the peer
+    dead and raise `RankFailure` between steps, so they exit instead of
+    hanging in a collective (and ranks stuck inside an XLA collective get
+    SIGTERM'd here regardless — XLA collectives cannot be aborted).
+
+    Restart is resume: `checkpoint_dir` is exported as
+    `PADDLE_CHECKPOINT_DIR` into every spawned rank, so trainers using
+    `CheckpointManager` (`HybridParallelEngine(save_every=..., resume=
+    True)`) continue from the newest COMMITTED step.
+    """
+
+    def __init__(self, cmd_fn, nprocs, checkpoint_dir=None, max_restarts=3,
+                 poll_interval=0.2, grace=10.0, log=print, log_dir=None,
+                 master_host="127.0.0.1", env_fn=None, port_fn=None):
+        # cmd_fn(rank, attempt) -> argv (a static argv list also works)
+        self.cmd_fn = (cmd_fn if callable(cmd_fn)
+                       else (lambda rank, attempt: list(cmd_fn)))
+        self.nprocs = int(nprocs)
+        self.checkpoint_dir = checkpoint_dir
+        self.max_restarts = max_restarts
+        self.poll_interval = poll_interval
+        self.grace = grace
+        self.log = log
+        self.log_dir = log_dir
+        self.master_host = master_host
+        # env_fn(rank, attempt) -> extra env; the chaos tests use it to arm
+        # PADDLE_CHAOS on one rank of one attempt only
+        self.env_fn = env_fn
+        self.port_fn = port_fn or (lambda: _free_port(master_host))
+        self.restarts = 0
+
+    def _spawn_world(self, attempt):
+        # a FRESH master port per attempt: the previous world's rendezvous
+        # store (master port + 1) may linger in TIME_WAIT or still be held
+        # by a survivor mid-SIGTERM
+        port = self.port_fn()
+        procs = []
+        for rank in range(self.nprocs):
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(self.nprocs),
+                "PADDLE_MASTER": f"{self.master_host}:{port}",
+                "PADDLE_RESTART_ATTEMPT": str(attempt),
+            })
+            if self.checkpoint_dir is not None:
+                env["PADDLE_CHECKPOINT_DIR"] = self.checkpoint_dir
+            if self.env_fn is not None:
+                env.update(self.env_fn(rank, attempt) or {})
+            stdout = stderr = None
+            if self.log_dir:
+                os.makedirs(self.log_dir, exist_ok=True)
+                logf = open(os.path.join(self.log_dir,
+                                         f"rank_{rank}.log"), "ab")
+                logf.write(f"\n===== attempt {attempt} =====\n".encode())
+                logf.flush()
+                stdout, stderr = logf, subprocess.STDOUT
+            procs.append(subprocess.Popen(
+                self.cmd_fn(rank, attempt), env=env,
+                stdout=stdout, stderr=stderr))
+        return procs
+
+    def _kill_survivors(self, procs):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + self.grace
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()  # stuck inside an XLA collective; no cleanup
+                    p.wait()
+
+    def _watch(self, procs):
+        """0 once every rank exited 0; on the first nonzero/signalled exit,
+        kill the survivors and return that rank's code."""
+        while True:
+            codes = [p.poll() for p in procs]
+            for rank, rc in enumerate(codes):
+                if rc is not None and rc != 0:
+                    self.log(f"[world-supervisor] rank {rank} died rc={rc} "
+                             "-> killing survivors, restarting the world")
+                    self._kill_survivors(procs)
+                    return rc
+            if all(rc == 0 for rc in codes):
+                return 0
+            time.sleep(self.poll_interval)
+
+    def run(self):
+        """Final exit code: 0 when a (re)started world ran to completion."""
+        attempt = 0
+        while True:
+            procs = self._spawn_world(attempt)
+            rc = self._watch(procs)
+            if rc == 0:
+                if attempt:
+                    self.log(f"[world-supervisor] world completed after "
+                             f"{attempt} restart(s)")
+                return 0
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                self.log(f"[world-supervisor] exceeded max_restarts="
+                         f"{self.max_restarts}; giving up")
+                return rc
+            attempt += 1
+            self.log(f"[world-supervisor] restart {self.restarts}/"
+                     f"{self.max_restarts}")

@@ -34,6 +34,7 @@ is a single `shard_map`-partitioned XLA program over a
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any
 
 import jax
@@ -176,7 +177,9 @@ class HybridParallelEngine:
                  devices=None, dtype=jnp.float32, remat=True, lr=3e-4,
                  schedule="gpipe", num_virtual_stages=2, zero_stage=1,
                  loss_chunk=None, moments="f32", cp=1, cp_mode="ring",
-                 unroll=None, monitor=None, master_weights=False):
+                 unroll=None, monitor=None, master_weights=False,
+                 save_every=None, checkpoint=None, resume=False,
+                 keep_last_k=3):
         from paddle_tpu.models.llama import LlamaConfig  # noqa: F401 (type)
 
         self.config = config
@@ -325,6 +328,27 @@ class HybridParallelEngine:
         # follow the llama formula)
         self._fpt_auto = monitor.flops_per_token is None
         self._fpt_seq = None  # seq len the monitor's flops_per_token is for
+
+        # -- fault tolerance: periodic atomic checkpoints + resume ----------
+        # save_every=N commits {"params", "opt"} every N completed steps
+        # through CheckpointManager (async single-process; the manager
+        # degrades to sync under multi-process). `checkpoint` is a root dir
+        # or a CheckpointManager; with neither, the manager falls back to
+        # $PADDLE_CHECKPOINT_DIR — which the elastic supervisor exports, so
+        # a supervisor-restarted trainer with resume=True continues from
+        # the newest COMMITTED step via maybe_resume().
+        self._save_every = int(save_every) if save_every else None
+        self._resume = bool(resume)
+        self._global_step = 0  # completed train_batch calls (resume-aware)
+        self.checkpoint_manager = None
+        if (self._save_every or resume or checkpoint is not None):
+            from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+            if isinstance(checkpoint, CheckpointManager):
+                self.checkpoint_manager = checkpoint
+            else:
+                self.checkpoint_manager = CheckpointManager(
+                    root=checkpoint, keep_last_k=keep_last_k)
 
     # -- sharding specs -----------------------------------------------------
     def _build_param_specs(self):
@@ -486,6 +510,26 @@ class HybridParallelEngine:
             out_shardings=self._opt_shardings)
         opt_state = opt_init(params)
         return params, opt_state
+
+    def maybe_resume(self, params, opt_state):
+        """(params, opt_state, start_step): restored from the newest
+        COMMITTED checkpoint when resume=True was requested and one
+        exists, otherwise passed through with start_step=0. Restore is
+        in place into the freshly initialised (correctly sharded) state,
+        so the trainer loop is identical either way:
+
+            params, opt = engine.init_state(seed)
+            params, opt, start = engine.maybe_resume(params, opt)
+            for step in range(start, total_steps): ...
+        """
+        if self.checkpoint_manager is None or not self._resume:
+            return params, opt_state, 0
+        state = {"params": params, "opt": opt_state}
+        extras = self.checkpoint_manager.resume(state)
+        if extras is None:
+            return params, opt_state, 0
+        self._global_step = int(extras.get("step", 0))
+        return state["params"], state["opt"], self._global_step
 
     def _spec_tree(self, like):
         """Expand self._param_specs (with P leaves) to match `like`'s tree."""
@@ -1205,4 +1249,19 @@ class HybridParallelEngine:
 
         if _dbg.checking_enabled():  # FLAGS_check_nan_inf post-step scan
             _dbg.assert_finite(out[0], where="HybridParallelEngine loss")
+        self._global_step += 1
+        if (self.checkpoint_manager is not None and self._save_every
+                and self._global_step % self._save_every == 0):
+            # out = (loss, new_params, new_opt): the POST-step state is what
+            # gets committed as step N ("N completed steps"); the manager
+            # snapshots device->host before returning, so the caller may
+            # immediately feed these (donated) arrays back into the next
+            # step. Writer errors surface on the handle / next save's wait.
+            self.checkpoint_manager.save(
+                {"params": out[1], "opt": out[2]}, self._global_step)
+        if os.environ.get("PADDLE_CHAOS"):
+            from paddle_tpu.distributed.checkpoint.integrity import (
+                chaos_point)
+
+            chaos_point("step_end", step=self._global_step)
         return out

@@ -6,10 +6,48 @@ without jax. Tensors reload onto the current default device lazily.
 
 import os
 import pickle
+import tempfile
 
 import numpy as np
 
 from paddle_tpu.core.tensor import Tensor
+
+# probed once: mkstemp creates 0600 files; atomic_write re-applies the
+# process umask so a replaced file keeps conventional permissions
+_UMASK = None
+
+
+def _umask():
+    global _UMASK
+    if _UMASK is None:
+        cur = os.umask(0)
+        os.umask(cur)
+        _UMASK = cur
+    return _UMASK
+
+
+def atomic_write(path, write_fn, mode="wb"):
+    """Crash-safe file write: tempfile in the target dir -> flush -> fsync
+    -> os.replace. Readers see either the old bytes or the complete new
+    bytes, never a torn file — the primitive under checkpoint metadata,
+    commit markers, and `save` below."""
+    path = os.fspath(path)
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=os.path.basename(path) + ".",
+                               suffix=".part")
+    try:
+        with os.fdopen(fd, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.chmod(tmp, 0o666 & ~_umask())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def _to_saveable(obj):
@@ -39,8 +77,9 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    # atomic: a crash mid-save must not destroy the previous file at `path`
+    atomic_write(path, lambda f: pickle.dump(_to_saveable(obj), f,
+                                             protocol=protocol))
 
 
 def load(path, **configs):

@@ -234,7 +234,10 @@ def test_checkpoint_async_save(tmp_path):
 
     state = {"w": paddle.to_tensor(np.ones((4, 4), np.float32))}
     th = save_state_dict(state, str(tmp_path / "ck2"), async_save=True)
-    th.join()
+    assert th.result() == str(tmp_path / "ck2")   # re-raises writer errors
+    assert th.done()
+    with pytest.warns(DeprecationWarning):
+        th.join()  # legacy spelling that used to swallow errors
     tgt = {"w": paddle.zeros([4, 4])}
     load_state_dict(tgt, str(tmp_path / "ck2"))
     np.testing.assert_allclose(tgt["w"].numpy(), 1.0)
