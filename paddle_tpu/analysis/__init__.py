@@ -1,0 +1,48 @@
+"""Static analysis for compiled programs (the framework's self-check layer).
+
+The reference framework ships an entire static layer of runtime
+self-checks (the `PHI_DEFINE_EXPORTED_*` flag census, FLAGS_check_nan_inf,
+accuracy-compare tooling). paddle_tpu's equivalents are *invariants on the
+compiled program itself* — no [b, s, vocab] buffer in the fused-CE step,
+opt state donated, exactly one psum per row-parallel matmul — and this
+package checks them statically: trace the real program, walk its jaxpr /
+lowered MLIR, and fail loudly (with eqn provenance) when an invariant
+breaks. Everything here runs at test time, on CPU, in seconds; nothing
+waits for a bench run to notice a regression.
+
+Layout:
+  jaxpr_walk        reusable jaxpr walker (scan/cond/custom_vjp/shard_map
+                    subjaxprs, source_info provenance)
+  buffer_audit      largest intermediates, byte ceilings, forbidden shapes
+  donation_audit    input-output aliasing of donated args in lowered MLIR
+  dtype_audit       f32 dot_generals under a bf16 policy (allowlisted sites)
+  host_sync_audit   callbacks / infeed in step programs
+  collective_audit  psum census + fingerprint per shard_map program
+  programs          builders that trace the REAL program families at toy
+                    size (train step, paged serving steps, fused CE,
+                    optimizer write-back)
+  presets           the default audit suite `tools/lint.py` runs in CI
+
+See ARCHITECTURE.md "Static analysis" for the rule inventory and how to
+add a rule.
+"""
+
+from paddle_tpu.analysis.base import Violation  # noqa: F401
+from paddle_tpu.analysis import (  # noqa: F401
+    buffer_audit,
+    collective_audit,
+    donation_audit,
+    dtype_audit,
+    host_sync_audit,
+    jaxpr_walk,
+)
+
+__all__ = [
+    "Violation",
+    "jaxpr_walk",
+    "buffer_audit",
+    "donation_audit",
+    "dtype_audit",
+    "host_sync_audit",
+    "collective_audit",
+]

@@ -1,0 +1,106 @@
+"""Collective audit: census + fingerprint of a program's collectives.
+
+The TP serving programs (and the hybrid engine's shard_map step) have a
+*known* collective structure: every row-parallel matmul carries exactly
+one psum reduce epilogue — 2 per decoder layer (wo, w_down), nothing
+else. An accidentally doubled psum (e.g. a helper that reduces AND a
+caller that reduces again) is numerically WRONG only for non-idempotent
+content but always slow; a dropped psum is silently wrong on >1 chips and
+invisible on the dp=1 CI rig. End-to-end parity catches these late and
+expensively — the census catches them at trace time.
+
+  collective_census(jaxpr)   ordered [(prim, axes, shape)] of every
+                             collective, in program order.
+  fingerprint(census)        stable 12-hex digest of the (prim, axes)
+                             sequence — goldens pin it per program.
+  check_collectives(...)     count and/or fingerprint must match.
+
+Rule ids: collective.count-mismatch, collective.fingerprint-mismatch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from paddle_tpu.analysis.base import Violation
+from paddle_tpu.analysis.jaxpr_walk import iter_eqns, provenance
+
+__all__ = ["COLLECTIVE_PRIMITIVES", "collective_census", "fingerprint",
+           "check_collectives"]
+
+COLLECTIVE_PRIMITIVES = frozenset({
+    "psum", "psum2", "pmax", "pmin", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "psum_scatter", "reduce_scatter",
+})
+
+
+def _axes_of(eqn):
+    params = eqn.params
+    axes = params.get("axes", params.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(str(a) for a in axes)
+
+
+def collective_census(jaxpr, prims=COLLECTIVE_PRIMITIVES):
+    """Ordered census of the program's collectives:
+    [{prim, axes, shape, provenance}] in deterministic walk order."""
+    out = []
+    for eqn, path in iter_eqns(jaxpr):
+        if eqn.primitive.name not in prims:
+            continue
+        shape = ()
+        if eqn.outvars and hasattr(eqn.outvars[0], "aval"):
+            shape = tuple(getattr(eqn.outvars[0].aval, "shape", ()))
+        out.append({
+            "prim": eqn.primitive.name,
+            "axes": _axes_of(eqn),
+            "shape": shape,
+            "path": "/".join(path),
+            "provenance": provenance(eqn),
+        })
+    return out
+
+
+def fingerprint(census):
+    """Order-sensitive digest of the (prim, axes) sequence. Shapes are
+    excluded so the fingerprint is stable across batch-size/toy-size
+    changes; a doubled, dropped, or reordered collective changes it."""
+    text = ";".join(f"{c['prim']}@{','.join(c['axes'])}" for c in census)
+    return hashlib.sha1(text.encode()).hexdigest()[:12]
+
+
+def check_collectives(jaxpr, program, expect_count=None,
+                      expect_fingerprint=None, prims=COLLECTIVE_PRIMITIVES):
+    """Pin the program's collective structure. `expect_count` is usually
+    a formula of the model (2 * num_layers psums for Megatron TP);
+    `expect_fingerprint` is the golden digest — regenerate with
+    `fingerprint(collective_census(jaxpr))` after an INTENTIONAL change
+    and say why in the diff."""
+    census = collective_census(jaxpr, prims=prims)
+    out = []
+    if expect_count is not None and len(census) != expect_count:
+        sites = ", ".join(
+            f"{c['prim']}@{','.join(c['axes'])} [{c['provenance']}]"
+            for c in census[:6]) or "none"
+        out.append(Violation(
+            rule="collective.count-mismatch",
+            program=program,
+            message=(f"expected {expect_count} collectives, found "
+                     f"{len(census)}: {sites}"
+                     + (" ..." if len(census) > 6 else "")),
+            provenance=census[0]["provenance"] if census else ""))
+    if expect_fingerprint is not None:
+        got = fingerprint(census)
+        if got != expect_fingerprint:
+            seq = ";".join(f"{c['prim']}@{','.join(c['axes'])}"
+                           for c in census)
+            out.append(Violation(
+                rule="collective.fingerprint-mismatch",
+                program=program,
+                message=(f"collective fingerprint {got} != golden "
+                         f"{expect_fingerprint} (sequence: {seq or 'empty'})"
+                         " — doubled/dropped/reordered collective, or an "
+                         "intentional change that must update the golden"),
+                provenance=census[0]["provenance"] if census else ""))
+    return out

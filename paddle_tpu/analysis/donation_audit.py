@@ -1,0 +1,177 @@
+"""Donation audit: donated inputs must actually alias an output.
+
+`donate_argnums` is a *request* — jax silently drops the alias when
+shapes/dtypes don't line up with any output (the "Some donated buffers
+were not usable" warning is easy to lose in CI logs), and a refactor
+that, say, casts the opt state on the way out doubles the optimizer's
+HBM without failing anything. This audit reads the lowered MLIR, where a
+kept alias is explicit: donated-and-used arguments carry
+`tf.aliasing_output = N` on the @main signature.
+
+Audited invariants (wired up in analysis.presets):
+  - the train step's params AND opt state are fully aliased (no
+    double-buffered master weights / moments);
+  - the fused optimizer write-back aliases params + opt state;
+  - the serving step programs alias the KV page pool (pk/pv) — the
+    buffers the engine threads through every step.
+
+Two lowering flavours carry the evidence differently:
+  - single-device jit writes the KEPT alias directly on the StableHLO
+    @main signature: `tf.aliasing_output = N`;
+  - SPMD (mesh/shard_map) lowering only marks the request
+    (`jax.buffer_donor = true`) and resolves aliasing at compile time —
+    there the proof lives in the compiled HLO header:
+    `input_output_alias={ {out}: (param, {}, may-alias), ... }`.
+`check_donation` accepts both: pass `compiled_text` for mesh programs.
+
+The flat argument index in MLIR is the flattened (args, kwargs) leaf
+order, which is how `check_donation` maps "argument 6's pytree" onto
+`%argN` attributes. Caveat: jit prunes UNUSED args from the lowering
+(keep_unused=False default), which shifts indices — pass `kept` (the
+lowering's kept_var_idx) to remap, or `check_donation` cross-checks the
+lowered arg count against the flattened count and refuses to guess when
+they disagree.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+
+from paddle_tpu.analysis.base import Violation
+
+__all__ = ["alias_map", "hlo_alias_map", "arg_offsets", "check_donation"]
+
+_ARG_RE = re.compile(r"%arg(\d+):")
+_ALIAS_ATTR_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+# compiled-HLO header entry: "{0}: (3, {}, may-alias)" — output tuple
+# path, then the parameter index
+_HLO_ALIAS_ENTRY_RE = re.compile(r"\{([\d,\s]*)\}:\s*\((\d+)")
+
+
+def _main_signature(mlir_text):
+    """The @main func signature line (aliasing attrs live only there)."""
+    for line in mlir_text.splitlines():
+        if "func.func public @main" in line:
+            return line
+    return mlir_text  # fall back to scanning everything
+
+
+def alias_map(lowered_or_text):
+    """Lowered (or its MLIR text) -> {flat_arg_index: output_index} of the
+    aliases the lowering actually kept. Parsed per-argument segment (the
+    attr dict can nest braces, e.g. mhlo.sharding = "{replicated}", so a
+    single regex across the signature would misparse):
+    "%arg7: tensor<2x64xf32> {..., tf.aliasing_output = 3 : i32}"."""
+    text = (lowered_or_text if isinstance(lowered_or_text, str)
+            else lowered_or_text.as_text())
+    sig = _main_signature(text)
+    hits = list(_ARG_RE.finditer(sig))
+    out = {}
+    for i, m in enumerate(hits):
+        seg = sig[m.end():hits[i + 1].start() if i + 1 < len(hits)
+                  else len(sig)]
+        alias = _ALIAS_ATTR_RE.search(seg)
+        if alias:
+            out[int(m.group(1))] = int(alias.group(1))
+    return out
+
+
+def hlo_alias_map(compiled_text):
+    """Compiled-HLO text -> {param_index: output_tuple_path} from the
+    module header's input_output_alias directive (the SPMD path: mesh
+    lowerings resolve donation at compile time, not in StableHLO). The
+    block nests braces ({0}: (3, {}, may-alias)) so it is brace-counted,
+    not regexed, out of the header."""
+    key = "input_output_alias={"
+    i = compiled_text.find(key)
+    if i < 0:
+        return {}
+    j, depth = i + len(key), 1
+    while j < len(compiled_text) and depth:
+        c = compiled_text[j]
+        depth += (c == "{") - (c == "}")
+        j += 1
+    block = compiled_text[i + len(key):j - 1]
+    return {int(m.group(2)): m.group(1).strip()
+            for m in _HLO_ALIAS_ENTRY_RE.finditer(block)}
+
+
+def _main_arg_count(mlir_text):
+    sig = _main_signature(mlir_text)
+    idxs = [int(m) for m in _ARG_RE.findall(sig)]
+    return (max(idxs) + 1) if idxs else 0
+
+
+def arg_offsets(example_args):
+    """Positional example args -> [(start, n_leaves)] so argnum i's leaves
+    occupy flat MLIR args [start, start + n)."""
+    offsets, pos = [], 0
+    for a in example_args:
+        n = len(jax.tree_util.tree_leaves(a))
+        offsets.append((pos, n))
+        pos += n
+    return offsets
+
+
+def check_donation(lowered, example_args, donated_argnums, program,
+                   arg_names=None, kept=None, compiled_text=None):
+    """Every leaf of every donated positional arg must carry a kept alias
+    in the lowered program. `example_args` must be the same positional
+    structure the program was lowered with (ShapeDtypeStructs are fine —
+    only the tree structure is read). `kept` is the lowering's
+    kept_var_idx (original flat indices that survived unused-arg
+    pruning); pruned donated leaves hold no buffer and are skipped.
+    `compiled_text` supplies the compiled-HLO input_output_alias header
+    for SPMD programs, whose StableHLO only records the donation request
+    (jax.buffer_donor), not the resolved alias."""
+    text = lowered if isinstance(lowered, str) else lowered.as_text()
+    aliases = dict(alias_map(text))
+    if compiled_text:
+        aliases.update(hlo_alias_map(compiled_text))
+    offsets = arg_offsets(example_args)
+    total = sum(n for _, n in offsets)
+    lowered_n = _main_arg_count(text)
+    out = []
+    if kept is not None:
+        # MLIR arg j is the j-th kept original index
+        rank = {orig: j for j, orig in enumerate(sorted(kept))}
+        expect_n = len(kept)
+    else:
+        rank = {i: i for i in range(total)}
+        expect_n = total
+    if lowered_n != expect_n:
+        # misaligned indices would garble every report below — report the
+        # mismatch itself instead of guessing
+        return [Violation(
+            rule="donation.arg-mismatch",
+            program=program,
+            message=(f"lowered @main has {lowered_n} args but expected "
+                     f"{expect_n} ({total} example leaves"
+                     + (f", {len(kept)} kept" if kept is not None else "")
+                     + ") — donation audit cannot map argnums"))]
+    for argnum in donated_argnums:
+        start, n = offsets[argnum]
+        name = (arg_names[argnum] if arg_names else f"arg{argnum}")
+        leaves, _ = jax.tree_util.tree_flatten_with_path(
+            example_args[argnum])
+        missing = [
+            (i, leaves[i][0] if i < len(leaves) else None)
+            for i in range(n)
+            if (start + i) in rank and rank[start + i] not in aliases]
+        for i, path in missing[:5]:
+            leaf = jax.tree_util.keystr(path) if path is not None else f"[{i}]"
+            out.append(Violation(
+                rule="donation.not-aliased",
+                program=program,
+                message=(f"donated input {name}{leaf} (flat arg "
+                         f"{start + i}) has no input-output alias in the "
+                         "lowered program — its HBM is double-buffered"),
+            ))
+        if len(missing) > 5:
+            out.append(Violation(
+                rule="donation.not-aliased", program=program,
+                message=(f"... and {len(missing) - 5} more unaliased "
+                         f"leaves of {name}")))
+    return out

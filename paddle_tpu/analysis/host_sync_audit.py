@@ -1,0 +1,48 @@
+"""Host-sync audit: step programs must not round-trip through the host.
+
+A `pure_callback` / `debug_callback` / infeed inside a train or serving
+step serializes the device against the Python thread every single step —
+the kind of change that lands as "just a debug hook" and shows up weeks
+later as a 30% device-idle mystery. The audit walks the program for
+callback/transfer primitives and names the line that introduced one.
+
+Rule id: host-sync.callback-in-step.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.analysis.base import Violation
+from paddle_tpu.analysis.jaxpr_walk import iter_eqns, provenance
+
+__all__ = ["HOST_SYNC_PRIMITIVES", "check_host_sync"]
+
+HOST_SYNC_PRIMITIVES = frozenset({
+    "pure_callback",
+    "io_callback",
+    "debug_callback",
+    "callback",
+    "infeed",
+    "outfeed",
+    "host_local_array_to_global_array",
+    "device_to_host", "host_to_device",
+})
+
+
+def check_host_sync(jaxpr, program, allowed=()):
+    """Flag host-callback/transfer primitives anywhere in the program.
+    `allowed` lists primitive names tolerated for this program (e.g. an
+    input pipeline that genuinely infeeds)."""
+    out = []
+    for eqn, path in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in HOST_SYNC_PRIMITIVES or name in allowed:
+            continue
+        where = "/".join(path) if path else "top level"
+        out.append(Violation(
+            rule="host-sync.callback-in-step",
+            program=program,
+            message=(f"host round-trip primitive '{name}' inside the step "
+                     f"program ({where}) — every step now blocks on the "
+                     "Python thread"),
+            provenance=provenance(eqn)))
+    return out

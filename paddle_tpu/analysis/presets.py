@@ -1,0 +1,142 @@
+"""The wired-up CPU audit: every rule over every real program family.
+
+`run_cpu_audits()` is the single entry point tier-1 and tools/lint.py
+share. It builds the four program families at toy size (fused-CE
+fwd+bwd, the hybrid engine's train step, the fused optimizer
+write-back, the PagedEngine's captured serving steps) and applies the
+rule suite with the repo's pinned invariants:
+
+  - no [batch, seq, vocab] intermediate anywhere near the loss;
+  - per-program byte ceilings on the largest intermediate (backstop for
+    shape regressions the forbidden-shape probe doesn't name);
+  - donated state (params, opt state, KV page pool) actually aliased in
+    the lowered/compiled program;
+  - bf16 AMP: f32 dot_generals only at allowlisted loss/norm sites;
+  - no host callbacks in any step program;
+  - TP serving collectives pinned by count AND fingerprint — every
+    row-parallel matmul carries exactly one psum reduce epilogue (the
+    decoder layers are scanned, so the static census is per-body: 2
+    psums over ('mp',), for any layer count).
+
+GOLDEN fingerprints are regenerated with
+`collective_audit.fingerprint(collective_audit.collective_census(j))`
+after an INTENTIONAL collective change — say why in the diff.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.analysis import (buffer_audit, collective_audit,
+                                 donation_audit, dtype_audit,
+                                 host_sync_audit, programs)
+
+__all__ = ["GOLDEN_COLLECTIVES", "BYTE_CEILINGS", "run_cpu_audits"]
+
+# static collective structure of each serving program: the layer stack
+# is a scan, so the census counts the body once — 2 row-parallel psum
+# epilogues (wo, w_down) regardless of num_layers; page_copy is pure
+# data movement and must stay collective-free
+_TP_FP = "a91763b43edf"       # psum@mp;psum@mp
+_EMPTY_FP = "da39a3ee5e6b"    # empty census
+GOLDEN_COLLECTIVES = {
+    "paged_prefill": (2, _TP_FP),
+    "paged_decode": (2, _TP_FP),
+    "spec_verify": (2, _TP_FP),
+    "page_copy": (0, _EMPTY_FP),
+}
+
+# largest-intermediate ceilings at the toy geometry (measured max plus
+# ~40% headroom): a blowup past these means a buffer class that did not
+# exist when the budget was pinned
+BYTE_CEILINGS = {
+    "fused_ce_fwd_bwd": 12 * 1024,
+    "hybrid_train_step": 18 * 1024,
+    "fused_opt_writeback": 18 * 1024,
+    "paged_prefill": 26 * 1024,
+    "paged_decode": 26 * 1024,
+    "spec_verify": 26 * 1024,
+    "page_copy": 26 * 1024,
+}
+
+_TRAIN_ARG_NAMES = ("params", "opt_state", "ids", "labels")
+_OPT_ARG_NAMES = ("params", "grads", "opt_state")
+
+
+def _common(p, out):
+    """Rules every program family gets: host-sync ban + byte ceiling."""
+    out += host_sync_audit.check_host_sync(p.jaxpr, p.name)
+    ceiling = BYTE_CEILINGS.get(p.name)
+    if ceiling is not None:
+        out += buffer_audit.check_byte_ceiling(p.jaxpr, ceiling, p.name)
+
+
+def _donation(p, out, arg_names=None):
+    out += donation_audit.check_donation(
+        p.lowered_text, p.example_args, p.donated, p.name,
+        arg_names=arg_names, kept=p.kept, compiled_text=p.compiled_text)
+
+
+def audit_fused_ce():
+    fused, _ = programs.fused_ce_programs()
+    out = []
+    out += buffer_audit.check_forbidden_shape(
+        fused.jaxpr, fused.meta["forbidden_shape"], fused.name,
+        "full-logits")
+    _common(fused, out)
+    return out
+
+
+def audit_train_step():
+    p = programs.train_step_program()
+    out = []
+    out += buffer_audit.check_forbidden_shape(
+        p.jaxpr, p.meta["forbidden_shape"], p.name, "full-logits")
+    out += dtype_audit.check_dtype_policy(p.jaxpr, p.name,
+                                          policy=p.meta["policy"])
+    _donation(p, out, _TRAIN_ARG_NAMES)
+    _common(p, out)
+    return out
+
+
+def audit_opt_writeback():
+    p = programs.opt_writeback_program()
+    out = []
+    _donation(p, out, _OPT_ARG_NAMES)
+    _common(p, out)
+    return out
+
+
+def audit_serving(tp=2):
+    progs = programs.serving_programs(tp=tp)
+    out = []
+    from paddle_tpu.analysis.base import Violation
+    missing = sorted(set(GOLDEN_COLLECTIVES) - set(progs))
+    for name in missing:
+        # a family that silently stopped being captured is itself a
+        # finding — the audit must not go blind without failing
+        out.append(Violation(
+            rule="audit.program-not-captured", program=name,
+            message="serving program was never dispatched/captured — "
+                    "scheduler or capture-harness change?"))
+    for name, p in sorted(progs.items()):
+        count, fp = GOLDEN_COLLECTIVES.get(name, (None, None))
+        out += collective_audit.check_collectives(
+            p.jaxpr, name, expect_count=count, expect_fingerprint=fp)
+        _donation(p, out)
+        _common(p, out)
+    return out
+
+
+def run_cpu_audits(families=("fused_ce", "train_step", "opt_writeback",
+                             "serving")):
+    """Run every audit family; returns the full list of Violations
+    (empty = the repo's compiled programs uphold every invariant)."""
+    runners = {
+        "fused_ce": audit_fused_ce,
+        "train_step": audit_train_step,
+        "opt_writeback": audit_opt_writeback,
+        "serving": audit_serving,
+    }
+    out = []
+    for fam in families:
+        out += runners[fam]()
+    return out

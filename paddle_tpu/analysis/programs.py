@@ -1,0 +1,294 @@
+"""Builders that trace the REAL program families at toy size.
+
+The audit's whole value is that it inspects the programs production
+actually runs — not idealized stand-ins. Each builder here constructs
+the genuine code path (HybridParallelEngine.build_train_step, the
+PagedEngine's compiled step dict, fused_linear_cross_entropy,
+adamw_update) at a CPU-friendly toy size and returns `AuditProgram`
+records carrying the jaxpr (for walker rules) and the lowered MLIR (for
+the donation rule).
+
+Serving programs are captured, not reconstructed: the engine's jitted
+step callables are wrapped with a recorder, a couple of tiny requests
+are served, and the recorded example arguments re-trace the exact
+program objects the scheduler dispatched. A signature change in the
+engine therefore can't silently diverge from what the audit inspects.
+
+Everything is memoized per process — tests and tools/lint.py share one
+build.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["AuditProgram", "TOY", "toy_args", "fused_ce_programs",
+           "train_step_program", "opt_writeback_program",
+           "serving_programs"]
+
+# one toy geometry for every family: 2 layers, divisible by a degree-2
+# TP mesh (heads, kv heads, intermediate), tiny enough that every build
+# in this module traces in seconds on CPU. intermediate_size must NOT
+# equal vocab_size or the forbidden-(b,s,vocab) probe would false-flag
+# the MLP intermediates.
+TOY = dict(vocab_size=64, hidden_size=32, intermediate_size=48,
+           num_layers=2, num_heads=2, num_kv_heads=2)
+TOY_BATCH, TOY_SEQ, TOY_CHUNK = 2, 16, 8
+
+
+@dataclasses.dataclass
+class AuditProgram:
+    """One traced program, ready for rules: jaxpr for walker rules,
+    lowered MLIR text + example args + donated argnums for the donation
+    rule, meta for program-specific context (forbidden shapes, mesh)."""
+
+    name: str
+    jaxpr: object                       # ClosedJaxpr
+    lowered_text: str | None = None
+    example_args: tuple = ()
+    donated: tuple = ()
+    # kept_var_idx of the lowering (None = no pruning) and, for SPMD
+    # programs, the compiled-HLO text where the resolved aliases live
+    kept: frozenset | None = None
+    compiled_text: str | None = None
+    meta: dict = dataclasses.field(default_factory=dict)
+
+
+def toy_args(**overrides):
+    from paddle_tpu.models import llama_functional as lf
+
+    kw = dict(TOY, **overrides)
+    return lf.LlamaArgs(rope_theta=10000.0, rms_eps=1e-6, use_flash=False,
+                        **kw)
+
+
+def _sds(x):
+    return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+
+
+def _sds_tree(tree):
+    return jax.tree_util.tree_map(_sds, tree)
+
+
+def _from_traced(name, traced, example_args, donated, meta=None):
+    """AuditProgram from a jit Traced: jaxpr + lowered MLIR, plus the
+    lowering's kept_var_idx (unused-arg pruning shifts flat indices) and
+    — when donation is requested but the StableHLO only carries the
+    jax.buffer_donor mark (SPMD lowerings) — the compiled HLO text,
+    where the resolved input_output_alias header lives."""
+    lowered = traced.lower()
+    text = lowered.as_text()
+    kept = None
+    try:
+        kv = lowered._lowering.compile_args.get("kept_var_idx")
+        if kv is not None:
+            kept = frozenset(kv)
+    except AttributeError:
+        pass
+    compiled_text = None
+    if donated and "tf.aliasing_output" not in text:
+        compiled_text = lowered.compile().as_text()
+    return AuditProgram(
+        name, traced.jaxpr, lowered_text=text, example_args=example_args,
+        donated=donated, kept=kept, compiled_text=compiled_text,
+        meta=dict(meta or {}))
+
+
+class _Recorder:
+    """Wrap a jitted callable; record the first call's args as
+    ShapeDtypeStructs so the exact program can be re-traced for audit."""
+
+    def __init__(self, jitted):
+        self.jitted = jitted
+        self.args = None
+
+    def __call__(self, *a, **k):
+        if self.args is None and not k:
+            self.args = tuple(_sds_tree(x) for x in a)
+        return self.jitted(*a, **k)
+
+    def trace(self):
+        if self.args is None:
+            return None
+        return self.jitted.trace(*self.args)
+
+
+@functools.lru_cache(maxsize=None)
+def fused_ce_programs():
+    """Fused-CE fwd+bwd (the no-[b,s,vocab] family) AND the unchunked
+    reference — the reference is the teeth check: it MUST trip the
+    forbidden-shape rule or the probe has silently gone blind."""
+    from paddle_tpu.models import llama_functional as lf
+
+    args = toy_args()
+    b, s, chunk = TOY_BATCH, TOY_SEQ, TOY_CHUNK
+    kh, kw, kl = jax.random.split(jax.random.PRNGKey(0), 3)
+    h = jax.random.normal(kh, (b, s, args.hidden_size)) * 0.5
+    head = jax.random.normal(kw, (args.hidden_size, args.vocab_size)) * 0.05
+    labels = jax.random.randint(kl, (b, s), 0, args.vocab_size)
+
+    fused = jax.make_jaxpr(jax.value_and_grad(
+        lambda a, w: lf.fused_linear_cross_entropy(
+            a, w, labels, args, None, 1, chunk), argnums=(0, 1)))(h, head)
+    ref = jax.make_jaxpr(jax.value_and_grad(
+        lambda a, w: lf.parallel_cross_entropy(a @ w, labels, args,
+                                               None, 1),
+        argnums=(0, 1)))(h, head)
+    bsv = (b, s, args.vocab_size)
+    return (AuditProgram("fused_ce_fwd_bwd", fused,
+                         meta={"forbidden_shape": bsv}),
+            AuditProgram("unchunked_ce_reference", ref,
+                         meta={"forbidden_shape": bsv}))
+
+
+@functools.lru_cache(maxsize=None)
+def train_step_program(dtype_name="bfloat16"):
+    """The hybrid engine's REAL compiled train step (trivial 1x1x1 mesh —
+    the degenerate-mesh fast path), bf16 params, chunked fused-CE loss,
+    bf16 moments + f32 master weights: the program the MFU headline runs.
+    Donates params and opt state (argnums 0, 1)."""
+    from paddle_tpu.distributed.hybrid_engine import HybridParallelEngine
+    from paddle_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny(
+        vocab_size=TOY["vocab_size"], hidden_size=TOY["hidden_size"],
+        intermediate_size=TOY["intermediate_size"],
+        num_hidden_layers=TOY["num_layers"],
+        num_attention_heads=TOY["num_heads"],
+        num_key_value_heads=TOY["num_kv_heads"],
+        max_position_embeddings=TOY_SEQ, use_flash_attention=False)
+    eng = HybridParallelEngine(
+        cfg, dp=1, pp=1, mp=1, micro_batches=1,
+        dtype=jnp.dtype(dtype_name), remat=False,
+        loss_chunk=TOY_CHUNK, moments="bf16", master_weights=True)
+    params, opt = eng.init_state(0)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, TOY["vocab_size"],
+                       (TOY_BATCH, TOY_SEQ)).astype(np.int32)
+    labels = rng.integers(0, TOY["vocab_size"],
+                          (TOY_BATCH, TOY_SEQ)).astype(np.int32)
+    ids, labels = eng.shard_batch(ids, labels)
+    step = eng.build_train_step()
+    traced = step.trace(params, opt, ids, labels)
+    example = (_sds_tree(params), _sds_tree(opt), _sds_tree(ids),
+               _sds_tree(labels))
+    return _from_traced(
+        "hybrid_train_step", traced, example, donated=(0, 1),
+        meta={"policy": ("bf16" if dtype_name == "bfloat16" else "f32"),
+              "forbidden_shape": (TOY_BATCH, TOY_SEQ, TOY["vocab_size"])})
+
+
+@functools.lru_cache(maxsize=None)
+def opt_writeback_program(moments="bf16"):
+    """The fused optimizer write-back on its own: one jitted tree-level
+    adamw_update with donated params + opt state — the no-double-buffered
+    -HBM contract for the optimizer family."""
+    from paddle_tpu.distributed.hybrid_engine import adamw_init, adamw_update
+    from paddle_tpu.models import llama_functional as lf
+
+    # master_weights=False here: with masters on, adamw_update never
+    # reads the raw params (only their static dtype), jit prunes them
+    # from the lowering, and the flat-arg mapping breaks. The
+    # master-weights donation path is covered by train_step_program,
+    # where params feed the forward pass and survive pruning.
+    args = toy_args()
+    params = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16),
+        lf.init_params(args, jax.random.key(0)))
+    grads = jax.tree_util.tree_map(jnp.ones_like, params)
+    state = adamw_init(params, moments=moments, master_weights=False)
+    step = jax.jit(functools.partial(adamw_update, moments=moments),
+                   donate_argnums=(0, 2))
+    traced = step.trace(params, grads, state)
+    example = (_sds_tree(params), _sds_tree(grads), _sds_tree(state))
+    return _from_traced("fused_opt_writeback", traced, example,
+                        donated=(0, 2), meta={"policy": "bf16"})
+
+
+def _tp_mesh(degree=2):
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < degree:
+        return None
+    return Mesh(np.array(jax.devices()[:degree]), ("mp",))
+
+
+@functools.lru_cache(maxsize=None)
+def serving_programs(tp=2, num_heads=None):
+    """Capture the PagedEngine's real step programs by serving tiny
+    requests through two engines (plain TP: prefill/decode/COW page-copy;
+    TP + draft: the speculative verify), then re-tracing the captured
+    callables. tp=0 builds without a mesh (single-chip program shapes).
+    `num_heads` widens the toy head count when tp exceeds TOY's 2 heads
+    (the deep -m slow audits run tp=4).
+
+    Returns {name: AuditProgram}. The pool (pk/pv) argnums each program
+    donates ride in `donated`; meta carries the mesh degree and layer
+    count for the collective-census formula."""
+    from paddle_tpu.models import generation as gen
+    from paddle_tpu.models import llama_functional as lf
+    from paddle_tpu.serving import PagedEngine, Request
+
+    overrides = ({"num_heads": num_heads, "num_kv_heads": num_heads}
+                 if num_heads else {})
+    args = toy_args(**overrides)
+    params = lf.init_params(args, jax.random.key(0))
+    mesh = _tp_mesh(tp) if tp else None
+    if tp and mesh is None:
+        raise RuntimeError(
+            f"serving_programs(tp={tp}) needs >= {tp} devices "
+            f"(have {len(jax.devices())}); run under the virtual CPU mesh")
+    kw = dict(max_slots=2, max_len=32, page_size=8, min_bucket=8,
+              donate_steps=True, mesh=mesh)
+    rng = np.random.default_rng(7)
+
+    def prompt(n):
+        return rng.integers(1, args.vocab_size, size=n).astype(np.int32)
+
+    out = {}
+    meta = {"tp": tp if mesh is not None else 0,
+            "num_layers": args.num_layers}
+
+    # plain engine: prefill + decode captured by serving; the COW
+    # page-copy program never fires on the natural flow (the allocator
+    # only COWs shared/registered tail pages), so it is traced directly
+    # from the engine's own jitted object with the live pool shapes
+    eng = PagedEngine(params, args, **kw)
+    recs = {
+        "paged_prefill": _Recorder(eng._prefill_v[False]),
+        "paged_decode": _Recorder(eng._decode_v[False]),
+    }
+    eng._prefill_v[False] = recs["paged_prefill"]
+    eng._decode_v[False] = recs["paged_decode"]
+    eng.serve([Request(prompt(16), max_new_tokens=4),
+               Request(prompt(10), max_new_tokens=3)])
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    copy_args = (_sds_tree(eng._pk), _sds_tree(eng._pv), i32, i32)
+    out["page_copy"] = _from_traced(
+        "page_copy", eng._copy_page.trace(*copy_args), copy_args,
+        donated=(0, 1), meta=meta)
+    donated = {"paged_prefill": (6, 7), "paged_decode": (2, 3)}
+
+    # draft engine: the speculative verify program (plain decode is
+    # replaced by propose/verify rounds when a draft is loaded)
+    draft_params, draft_args = gen.draft_from_params(params, args,
+                                                     num_layers=1)
+    spec = PagedEngine(params, args, draft_params=draft_params,
+                       draft_args=draft_args, spec_tokens=2, **kw)
+    recs["spec_verify"] = _Recorder(spec._spec._verify)
+    spec._spec._verify = recs["spec_verify"]
+    spec.serve([Request(prompt(9), max_new_tokens=4)])
+    donated["spec_verify"] = (2, 3)
+
+    for name, rec in recs.items():
+        traced = rec.trace()
+        if traced is None:
+            continue  # program never dispatched (scheduler change?)
+        out[name] = _from_traced(name, traced, rec.args,
+                                 donated=donated[name], meta=meta)
+    return out

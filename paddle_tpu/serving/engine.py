@@ -179,7 +179,7 @@ class Engine:
     """
 
     def __init__(self, params, args, *, max_slots=4, max_len=256,
-                 min_bucket=16, pad_id=0, metrics=None):
+                 min_bucket=16, pad_id=0, metrics=None, donate_steps=None):
         self.params = params
         self.args = args
         self.max_slots = int(max_slots)
@@ -187,6 +187,11 @@ class Engine:
         self.min_bucket = int(min_bucket)
         self.pad_id = int(pad_id)
         self.metrics = metrics if metrics is not None else Metrics()
+        # donate_steps: None = auto (donate the KV buffers on TPU only);
+        # True/False force it. The static donation audit forces True on
+        # CPU so the lowered programs it inspects carry the same aliasing
+        # the production TPU programs do.
+        self._donate_steps = donate_steps
 
         self.queue = AdmissionQueue(self.metrics)
         self.slots = SlotTable(self.max_slots)
@@ -197,6 +202,12 @@ class Engine:
         self.step_count = 0
         self._stall_steps = 0     # decode work delayed by a prefill step
         self._setup_device_state()
+
+    def _donate_enabled(self):
+        """Whether step programs donate their threaded-through buffers."""
+        if self._donate_steps is not None:
+            return bool(self._donate_steps)
+        return jax.default_backend() == "tpu"
 
     def _setup_device_state(self):
         """Allocate the KV cache buffers + compile wrappers (subclass
@@ -218,8 +229,8 @@ class Engine:
         # input to output instead of materializing a fresh cache copy per
         # step (on the TPU bench shape that copy is ~1 GB/step). CPU/other
         # backends don't implement donation — skip it there to avoid a
-        # warning per compile.
-        donate = jax.default_backend() == "tpu"
+        # warning per compile (donate_steps=True forces it for audits).
+        donate = self._donate_enabled()
         self._prefill = jax.jit(
             functools.partial(_prefill_traced, args=args,
                               metrics=self.metrics),

@@ -180,7 +180,8 @@ class PagedEngine(Engine):
     def __init__(self, params, args, *, max_slots=4, max_len=256,
                  page_size=16, num_pages=None, min_bucket=16, pad_id=0,
                  metrics=None, mesh=None, tp_axis="mp", prefill_chunk=None,
-                 draft_params=None, draft_args=None, spec_tokens=4):
+                 draft_params=None, draft_args=None, spec_tokens=4,
+                 donate_steps=None):
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len={max_len} must be a multiple of "
@@ -211,7 +212,7 @@ class PagedEngine(Engine):
                 raise ValueError("draft and target must share a vocab")
         super().__init__(params, args, max_slots=max_slots, max_len=max_len,
                          min_bucket=min_bucket, pad_id=pad_id,
-                         metrics=metrics)
+                         metrics=metrics, donate_steps=donate_steps)
 
     @property
     def spec_enabled(self):
@@ -276,7 +277,7 @@ class PagedEngine(Engine):
         self._chunk_turn = False
         self._admit_idx = None     # _can_prefill's cached admission scan
 
-        donate = jax.default_backend() == "tpu"
+        donate = self._donate_enabled()
         rep = P()
         prefill_specs = dict(
             in_specs=(self._pspecs, rep, rep, rep, rep, rep,

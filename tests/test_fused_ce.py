@@ -135,51 +135,30 @@ class TestNoLogitsBuffer:
     def test_no_full_logits_intermediate_in_jaxpr(self):
         """The acceptance claim, in its CPU-checkable form: the fwd+bwd
         jaxpr of the fused loss contains NO [b, s, vocab] value anywhere
-        (the scan works on [b, chunk, vocab] tiles). The unchunked
-        reference trips this check, proving the probe has teeth."""
+        (the scan works on [b, chunk, vocab] tiles) — checked with the
+        shared analysis walker, which descends into custom_vjp/scan/
+        shard_map subjaxprs. The unchunked reference trips this check,
+        proving the probe has teeth."""
+        from paddle_tpu.analysis import buffer_audit
+
         b, s = 2, 64
         h, head, labels = _inputs(b=b, s=s)
-
-        def subjaxprs(params):
-            for v in params.values():
-                vals = v if isinstance(v, (tuple, list)) else (v,)
-                for item in vals:
-                    jx = getattr(item, "jaxpr", None)
-                    if jx is not None:
-                        yield jx
-                    elif hasattr(item, "eqns"):
-                        yield item
-
-        def has_bsv(jaxpr, shape):
-            seen = set()
-
-            def walk(jx):
-                if id(jx) in seen:
-                    return False
-                seen.add(id(jx))
-                for eqn in jx.eqns:
-                    for v in list(eqn.invars) + list(eqn.outvars):
-                        if getattr(getattr(v, "aval", None), "shape",
-                                   None) == shape:
-                            return True
-                    for sub in subjaxprs(eqn.params):
-                        if walk(sub):
-                            return True
-                return False
-
-            return walk(jaxpr)
 
         bsv = (b, s, ARGS.vocab_size)
         fused = jax.make_jaxpr(jax.value_and_grad(
             lambda a, w: lf.fused_linear_cross_entropy(
                 a, w, labels, ARGS, None, 1, 16), argnums=(0, 1)))(h, head)
-        assert not has_bsv(fused.jaxpr, bsv), \
+        assert not buffer_audit.has_shape(fused, bsv), \
             "fused CE materialized a [b, s, vocab] buffer"
 
         ref = jax.make_jaxpr(jax.value_and_grad(
             lambda a, w: _ref_loss(a, w, labels), argnums=(0, 1)))(h, head)
-        assert has_bsv(ref.jaxpr, bsv), \
+        assert buffer_audit.has_shape(ref, bsv), \
             "probe lost its teeth: unchunked path shows no logits buffer"
+        # and the rule form reports provenance for the offending site
+        v = buffer_audit.check_forbidden_shape(ref, bsv, "unchunked_ref",
+                                               "full-logits")
+        assert v and all(x.rule == "buffer.forbidden-shape" for x in v)
 
 
 class TestVocabParallel:

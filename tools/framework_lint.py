@@ -1,0 +1,429 @@
+#!/usr/bin/env python
+"""Framework AST lint: host-sync and lock-discipline rules.
+
+The compiled-program auditor (paddle_tpu/analysis) proves invariants on
+traced programs; this lint catches the bug classes that never make it
+into a jaxpr — they bite at trace time or on the host side:
+
+  JIT01  int()/float()/bool()/.item() inside a traced function. Each one
+         forces a device->host transfer + blocks dispatch when the value
+         is traced; at best it silently constant-folds a shape probe.
+  JIT02  time.time()/perf_counter()/monotonic() inside a traced
+         function: evaluated ONCE at trace time and baked into the
+         program as a constant — timing that silently measures nothing.
+  JIT03  np.random.* inside a traced function: numpy's global RNG runs
+         at trace time, so every execution replays the same "random"
+         constants (and breaks reproducibility-by-key).
+  LOCK01 shared-state lock discipline in serving/ and
+         distributed/checkpoint/: a name that is mutated under a
+         `with <lock>:` somewhere must be mutated under it everywhere
+         (a single unguarded .add() reintroduces exactly the
+         registry/allocator race the lock exists to prevent).
+
+"Traced" is syntactic, by repo convention: a function whose name ends
+in `_traced`, a function decorated with jit/pjit, a function whose NAME
+is passed to jax.jit / shard_map / grad / value_and_grad / vmap / pmap /
+checkpoint / custom_vjp / lax.scan (possibly through functools.partial),
+and any function nested inside one of those.
+
+False positives are allowlisted in tools/lint_allowlist.txt — one entry
+per line, justification REQUIRED:
+
+    RULE path/to/file.py::qualname -- why this one is fine
+
+Stale entries (no longer matching any violation) are themselves errors,
+so the allowlist can only shrink unless someone writes a new
+justification.
+
+Run directly (`python tools/framework_lint.py [paths]`) or through
+tools/lint.py, which adds the compiled-program audits.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import sys
+
+__all__ = ["LintViolation", "lint_file", "lint_paths", "load_allowlist",
+           "apply_allowlist", "main"]
+
+DEFAULT_ROOTS = ("paddle_tpu", "tools")
+# LOCK01 is scoped to the shared-mutable-state subsystems
+LOCK_SCOPE = (os.path.join("paddle_tpu", "serving"),
+              os.path.join("paddle_tpu", "distributed", "checkpoint"),)
+
+_TRACED_ENTRYPOINTS = {
+    "jit", "pjit", "shard_map", "grad", "value_and_grad", "vmap", "pmap",
+    "checkpoint", "remat", "custom_vjp", "custom_jvp", "scan", "while_loop",
+    "fori_loop", "cond",
+}
+_HOST_CASTS = {"int", "float", "bool"}
+_TIME_FUNCS = {"time", "perf_counter", "monotonic", "process_time"}
+_MUTATING_METHODS = {
+    "add", "discard", "remove", "clear", "update", "pop", "popitem",
+    "append", "extend", "insert", "setdefault", "__setitem__",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class LintViolation:
+    rule: str
+    path: str
+    line: int
+    qualname: str
+    message: str
+
+    @property
+    def key(self):
+        return f"{self.rule} {self.path}::{self.qualname}"
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}: {self.rule} in {self.qualname}: "
+                f"{self.message}")
+
+
+def _dotted(node):
+    """Name/Attribute chain -> 'a.b.c' (or None for anything else)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lockish(expr):
+    """`with X:` context that looks like a lock (by naming convention:
+    _ACTIVE_LOCK, self._lock, cv, ...Lock)."""
+    d = _dotted(expr)
+    if d is None and isinstance(expr, ast.Call):
+        d = _dotted(expr.func)
+    return d is not None and "lock" in d.lower()
+
+
+def _fn_name_args(call):
+    """Function NAMES passed into a call — direct Name args plus names
+    inside functools.partial(...) args."""
+    out = []
+    for a in call.args:
+        if isinstance(a, ast.Name):
+            out.append(a.id)
+        elif isinstance(a, ast.Call):
+            f = _dotted(a.func)
+            if f and f.split(".")[-1] == "partial":
+                out.extend(x.id for x in a.args if isinstance(x, ast.Name))
+    return out
+
+
+class _FnInfo:
+    def __init__(self, node, qualname, parent):
+        self.node = node
+        self.qualname = qualname
+        self.parent = parent
+        self.traced = False
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """One pass: collect functions (with qualnames), decorator/trace
+    entrypoint evidence, and every with/mutation site."""
+
+    def __init__(self):
+        self.fns = {}               # ast node -> _FnInfo
+        self.stack = []             # enclosing _FnInfo / class names
+        self.traced_names = set()   # local names passed to jit & friends
+
+    _cur_fn_node = None
+
+    def _qual(self, name):
+        return ".".join(self.stack + [name]) if self.stack else name
+
+    def visit_FunctionDef(self, node):
+        info = _FnInfo(node, self._qual(node.name),
+                       self.fns.get(id(self._cur_fn_node)))
+        self.fns[id(node)] = info
+        if node.name.endswith("_traced"):
+            info.traced = True
+        for dec in node.decorator_list:
+            # plain @jit / @jax.jit, plus @functools.partial(jax.jit, ...)
+            cands = [dec]
+            if isinstance(dec, ast.Call):
+                cands = [dec.func] + list(dec.args)
+            for c in cands:
+                d = _dotted(c)
+                if d and d.split(".")[-1] in _TRACED_ENTRYPOINTS:
+                    info.traced = True
+        self.stack.append(node.name)
+        prev = self._cur_fn_node
+        self._cur_fn_node = node
+        self.generic_visit(node)
+        self._cur_fn_node = prev
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_Call(self, node):
+        d = _dotted(node.func)
+        if d and d.split(".")[-1] in _TRACED_ENTRYPOINTS:
+            self.traced_names.update(_fn_name_args(node))
+        self.generic_visit(node)
+
+
+def _mark_traced(index):
+    """Close tracedness: by-name references + nesting inside traced."""
+    by_name = {}
+    for info in index.fns.values():
+        by_name.setdefault(info.node.name, []).append(info)
+    for name in index.traced_names:
+        for info in by_name.get(name, []):
+            info.traced = True
+    changed = True
+    while changed:
+        changed = False
+        for info in index.fns.values():
+            if not info.traced and info.parent is not None \
+                    and info.parent.traced:
+                info.traced = True
+                changed = True
+
+
+def _check_traced_body(path, info, out):
+    """JIT01/02/03 inside one traced function (nested defs are visited
+    as their own traced _FnInfo, so skip them here)."""
+    nested = {id(n) for n in ast.walk(info.node)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and n is not info.node}
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if id(child) in nested:
+                continue
+            yield child
+            yield from walk(child)
+
+    for node in walk(info.node):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            tail = d.split(".")[-1] if d else None
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _HOST_CASTS and node.args:
+                out.append(LintViolation(
+                    "JIT01", path, node.lineno, info.qualname,
+                    f"{node.func.id}() on a traced value forces a "
+                    "device->host sync (or trace-time constant-folds); "
+                    "use jnp/astype or hoist to the host side"))
+            elif tail == "item" or (isinstance(node.func, ast.Attribute)
+                                    and node.func.attr == "item"):
+                out.append(LintViolation(
+                    "JIT01", path, node.lineno, info.qualname,
+                    ".item() inside a traced function blocks on a "
+                    "device->host transfer every step"))
+            elif d and (d.startswith("time.")
+                        and tail in _TIME_FUNCS):
+                out.append(LintViolation(
+                    "JIT02", path, node.lineno, info.qualname,
+                    f"{d}() runs at TRACE time and bakes a constant "
+                    "into the program — it measures nothing"))
+            elif d and (d.startswith("np.random.")
+                        or d.startswith("numpy.random.")):
+                out.append(LintViolation(
+                    "JIT03", path, node.lineno, info.qualname,
+                    f"{d}() draws from numpy's host RNG at trace time — "
+                    "the 'random' values are baked constants; use "
+                    "jax.random with an explicit key"))
+
+
+def _mutation_name(node, in_class):
+    """State key mutated by this node: ('self', attr) for self._x,
+    ('module', name) for module globals. None when not a mutation of a
+    trackable name."""
+    def key_of(target):
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self":
+            return ("self." + in_class if in_class else "self",
+                    target.attr)
+        if isinstance(target, ast.Name):
+            return ("module", target.id)
+        if isinstance(target, ast.Subscript):
+            return key_of(target.value)
+        return None
+
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            # only subscript/aug stores count for plain Names at module
+            # level — a bare rebind is the definition site, not a
+            # shared-state mutation
+            k = key_of(t)
+            if k is not None and (isinstance(t, (ast.Subscript,))
+                                  or isinstance(node, ast.AugAssign)
+                                  or k[0] != "module"):
+                return k
+    if isinstance(node, ast.Delete):
+        for t in node.targets:
+            k = key_of(t)
+            if k is not None:
+                return k
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr in _MUTATING_METHODS:
+        return key_of(node.func.value)
+    return None
+
+
+def _check_lock_discipline(path, tree, out):
+    """LOCK01: collect (state, mutated-under-lock?) sites, then flag
+    unguarded mutations of any state that is lock-guarded elsewhere."""
+    sites = []  # (key, under_lock, lineno, qualname, init_ctx)
+
+    def walk(node, under_lock, fn_stack, class_name):
+        for child in ast.iter_child_nodes(node):
+            cu = under_lock
+            fs, cn = fn_stack, class_name
+            if isinstance(child, ast.With):
+                if any(_is_lockish(item.context_expr)
+                       for item in child.items):
+                    cu = True
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                fs = fn_stack + [child.name]
+                cu = False  # a new frame does not inherit the with
+            elif isinstance(child, ast.ClassDef):
+                cn = child.name
+                fs = fn_stack + [child.name]
+            key = _mutation_name(child, class_name)
+            if key is not None:
+                init = bool(fn_stack) and fn_stack[-1] == "__init__" \
+                    or not fn_stack and not isinstance(child, ast.Call)
+                sites.append((key, under_lock, child.lineno,
+                              ".".join(fn_stack) or "<module>", init))
+            walk(child, cu, fs, cn)
+
+    walk(tree, False, [], None)
+    guarded = {k for k, under, _, _, init in sites if under and not init}
+    for key, under, line, qual, init in sites:
+        if key in guarded and not under and not init:
+            kind, name = key
+            out.append(LintViolation(
+                "LOCK01", path, line, qual,
+                f"{name} is mutated under a lock elsewhere in this "
+                "module but mutated here without holding it — "
+                "registry/allocator state must keep its lock discipline"))
+
+
+def lint_file(path, repo_root="."):
+    rel = os.path.relpath(path, repo_root)
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [LintViolation("PARSE", rel, e.lineno or 0, "<module>",
+                              f"syntax error: {e.msg}")]
+    index = _ModuleIndex()
+    index.visit(tree)
+    _mark_traced(index)
+    out = []
+    for info in index.fns.values():
+        if info.traced:
+            _check_traced_body(rel, info, out)
+    # scope by path segment so the check also works on trees linted from
+    # outside the repo root (the seeded-violation tests do exactly that)
+    apath = os.path.normpath(os.path.abspath(path))
+    if any(os.sep + scope + os.sep in apath for scope in LOCK_SCOPE):
+        _check_lock_discipline(rel, tree, out)
+    out.sort(key=lambda v: (v.path, v.line))
+    return out
+
+
+def lint_paths(paths, repo_root="."):
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.extend(lint_file(os.path.join(dirpath, fn),
+                                             repo_root))
+        elif p.endswith(".py"):
+            out.extend(lint_file(p, repo_root))
+    return out
+
+
+def load_allowlist(path):
+    """Parse the allowlist; returns ({key: justification}, [errors]).
+    Lines: 'RULE file.py::qualname -- justification'. A missing
+    justification is an ERROR — the file is the paper trail."""
+    entries, errors = {}, []
+    if not os.path.exists(path):
+        return entries, errors
+    with open(path) as f:
+        for n, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            key, sep, just = line.partition(" -- ")
+            key = " ".join(key.split())
+            if not sep or not just.strip():
+                errors.append(f"{path}:{n}: allowlist entry has no "
+                              "justification (format: 'RULE file.py::"
+                              "qualname -- why this one is fine')")
+                continue
+            entries[key] = just.strip()
+    return entries, errors
+
+
+def apply_allowlist(violations, entries):
+    """Filter allowlisted violations; UNUSED entries are errors so the
+    list cannot accrete stale exemptions."""
+    used = set()
+    kept = []
+    for v in violations:
+        if v.key in entries:
+            used.add(v.key)
+        else:
+            kept.append(v)
+    stale = [f"stale allowlist entry (no matching violation): {k}"
+             for k in sorted(set(entries) - used)]
+    return kept, stale
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None)
+    ap.add_argument("--allowlist",
+                    default=os.path.join(os.path.dirname(__file__),
+                                         "lint_allowlist.txt"))
+    ns = ap.parse_args(argv)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = ns.paths or [os.path.join(repo_root, r) for r in DEFAULT_ROOTS]
+    violations = lint_paths(paths, repo_root)
+    entries, errors = load_allowlist(ns.allowlist)
+    violations, stale = apply_allowlist(violations, entries)
+    for v in violations:
+        print(v)
+    for e in errors + stale:
+        print(f"ERROR: {e}")
+    n = len(violations) + len(errors) + len(stale)
+    if n:
+        print(f"framework_lint: {n} problem(s)")
+        return 1
+    print("framework_lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""One-command static gate: framework AST lint + compiled-program audit.
+
+    python tools/lint.py               # everything (CI entry point)
+    python tools/lint.py --ast-only    # the AST lint alone (no jax, fast)
+    python tools/lint.py --audit-only  # the compiled-program audit alone
+    python tools/lint.py --families serving train_step
+
+Exit code 0 = every invariant holds; 1 = violations (each printed with
+provenance). The compiled-program audit traces the REAL program
+families (hybrid train step, PagedEngine prefill/decode/verify,
+fused-CE fwd+bwd, fused optimizer write-back) at toy size on a virtual
+8-device CPU mesh — no accelerator needed. tests/test_static_audit.py
+runs the same entry in-process in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+ALL_FAMILIES = ("fused_ce", "train_step", "opt_writeback", "serving")
+
+
+def run_ast_lint():
+    import framework_lint
+
+    return framework_lint.main([])
+
+
+def run_program_audit(families=ALL_FAMILIES):
+    # must precede any jax import: the audit needs the 8-device CPU mesh
+    from _platform_setup import force_cpu_platform
+    force_cpu_platform(8)
+
+    from paddle_tpu.analysis import presets
+
+    violations = presets.run_cpu_audits(families=families)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"program audit: {len(violations)} violation(s)")
+        return 1
+    print(f"program audit: clean ({', '.join(families)})")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ast-only", action="store_true")
+    ap.add_argument("--audit-only", action="store_true")
+    ap.add_argument("--families", nargs="+", default=list(ALL_FAMILIES),
+                    choices=ALL_FAMILIES,
+                    help="program-audit families to run")
+    ns = ap.parse_args(argv)
+    rc = 0
+    if not ns.audit_only:
+        rc |= run_ast_lint()
+    if not ns.ast_only:
+        rc |= run_program_audit(tuple(ns.families))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

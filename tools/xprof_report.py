@@ -253,6 +253,31 @@ def format_report(rep, top_k=5):
     return "\n".join(lines)
 
 
+def check_gates(rep, min_busy_pct=None, max_non_matmul_pct=None,
+                min_overlap_pct=None):
+    """CI gates over a report dict -> list of failure strings. Exposed
+    for tests and for CI scripts that already hold the --json payload."""
+    failures = []
+    if min_busy_pct is not None and rep["device_busy_pct"] < min_busy_pct:
+        failures.append(
+            f"GATE device-busy {rep['device_busy_pct']:.2f}% < floor "
+            f"{min_busy_pct:.2f}%")
+    if max_non_matmul_pct is not None and rep["top_non_matmul"]:
+        top = rep["top_non_matmul"][0]
+        if top["pct_of_device"] > max_non_matmul_pct:
+            failures.append(
+                f"GATE top non-matmul consumer {top['name']} "
+                f"[{top['class']}] at {top['pct_of_device']:.2f}% of "
+                f"device time > ceiling {max_non_matmul_pct:.2f}%")
+    if min_overlap_pct is not None and rep["comm_total_s"] \
+            and rep["comm_compute_overlap_pct"] < min_overlap_pct:
+        failures.append(
+            f"GATE comm-compute overlap "
+            f"{rep['comm_compute_overlap_pct']:.2f}% < floor "
+            f"{min_overlap_pct:.2f}%")
+    return failures
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="Classify xprof device time into matmul / collective / "
@@ -262,7 +287,20 @@ def main(argv=None):
     ap.add_argument("--top", type=int, default=5, metavar="K",
                     help="top-K consumers per class (default 5)")
     ap.add_argument("--json", metavar="OUT", default=None,
-                    help="also write the report dict as JSON")
+                    help="also write the report dict as JSON "
+                         "('-' = stdout, for piping into jq/CI)")
+    ap.add_argument("--min-busy-pct", type=float, default=None,
+                    metavar="PCT",
+                    help="CI gate: exit 2 if device-busy %% is below PCT")
+    ap.add_argument("--max-non-matmul-pct", type=float, default=None,
+                    metavar="PCT",
+                    help="CI gate: exit 2 if the top non-matmul consumer "
+                         "takes more than PCT%% of device time")
+    ap.add_argument("--min-overlap-pct", type=float, default=None,
+                    metavar="PCT",
+                    help="CI gate: exit 2 if comm-compute overlap %% is "
+                         "below PCT (ignored when the trace has no "
+                         "collectives)")
     args = ap.parse_args(argv)
 
     events = load_events(args.trace)
@@ -272,12 +310,24 @@ def main(argv=None):
               "device lanes)", file=sys.stderr)
         return 1
     rep = build_report(events, top_k=args.top)
-    print(format_report(rep, top_k=args.top))
-    if args.json:
-        with open(args.json, "w") as f:
-            json.dump(rep, f, indent=2, sort_keys=True)
-            f.write("\n")
-        print(f"json report -> {args.json}")
+    if args.json == "-":
+        # machine-readable stdout: the human report moves to stderr
+        print(format_report(rep, top_k=args.top), file=sys.stderr)
+        json.dump(rep, sys.stdout, indent=2, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        print(format_report(rep, top_k=args.top))
+        if args.json:
+            with open(args.json, "w") as f:
+                json.dump(rep, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"json report -> {args.json}")
+    failures = check_gates(rep, args.min_busy_pct,
+                           args.max_non_matmul_pct, args.min_overlap_pct)
+    for msg in failures:
+        print(msg, file=sys.stderr)
+    if failures:
+        return 2
     return 0
 
 
