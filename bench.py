@@ -789,6 +789,311 @@ def _bench_paged_vs_stripe(params, args, backend, seed):
     }
 
 
+# top-1 token agreement floor for the int8 KV pool vs the model-dtype
+# pool: COW splits of partially-filled pages dequantize-requantize under
+# a fresh page absmax, so the contract is agreement, not bit-exactness
+# (empirically 1.00 on both the bench models; see TestInt8KVPool)
+_INT8_KV_AGREEMENT_BAR = 0.8
+
+
+def _bench_radix_prefix(params, args, backend, seed):
+    """Radix vs hash prefix cache on the partial-overlap trace (shared
+    system prompt, mid-page divergence — make_partial_overlap_trace).
+    Asserts IN-LEG: radix hits >= 1.3x the hash chain's prefix tokens,
+    and radix greedy output == sequential generate token-for-token with
+    the model-dtype weights AND with int8-quantized weights."""
+    from paddle_tpu.models.generation import generate, quantize_params
+    from paddle_tpu.serving import PagedEngine
+    from tools.serving_trace import make_partial_overlap_trace, trace_stats
+
+    if backend == "tpu":
+        ps, max_len, slots, min_bucket = 64, 1024, 8, 64
+        trace = make_partial_overlap_trace(
+            seed=seed, n_requests=12, base_len=176, divergence_points=(96,),
+            suffix_len_choices=(24, 40, 57), new_tokens_choices=(32,),
+            vocab_size=args.vocab_size)
+    else:
+        ps, max_len, slots, min_bucket = 8, 64, 4, 8
+        trace = make_partial_overlap_trace(
+            seed=seed, n_requests=12, base_len=22, divergence_points=(12,),
+            suffix_len_choices=(5, 9, 13), new_tokens_choices=(8,),
+            vocab_size=args.vocab_size)
+
+    refs = [np.asarray(generate(params, args, t["prompt"][None],
+                                max_new_tokens=t["max_new_tokens"]))[0]
+            for t in trace]
+
+    def run(p, policy, check=None):
+        eng = PagedEngine(p, args, max_slots=slots, max_len=max_len,
+                          page_size=ps, min_bucket=min_bucket,
+                          prefix_policy=policy)
+        eng.replay(trace)                    # warm every program
+        eng.reset()                          # reset colds the prefix cache
+        t0 = time.perf_counter()
+        reqs = eng.replay(trace)
+        dt = time.perf_counter() - t0
+        if check is not None:
+            for r, ref, t in zip(reqs, check, trace):
+                got = np.asarray(r.token_ids)
+                want = ref[len(t["prompt"]):len(t["prompt"]) + len(got)]
+                assert (got == want).all(), \
+                    f"{policy} diverged from sequential generate"
+        c = eng.metrics.summary()["counters"]
+        return {
+            "tokens_per_sec": round(
+                sum(len(r.token_ids) for r in reqs) / dt, 1),
+            "prefix_tokens_hit": c["prefix_tokens_hit"],
+            "prefix_hit_rate": round(
+                c["prefix_tokens_hit"] / max(c["prompt_tokens"], 1), 3),
+            "prefix_partial_hits": c.get("prefix_partial_hits", 0),
+            "radix_splits": c.get("radix_splits", 0),
+            "cow_copies": c.get("cow_copies", 0),
+        }
+
+    radix = run(params, "radix", check=refs)
+    hash_ = run(params, "hash", check=refs)
+    ratio = radix["prefix_tokens_hit"] / max(hash_["prefix_tokens_hit"], 1)
+    assert ratio >= 1.3, \
+        f"radix/hash hit ratio {ratio:.2f} < 1.3 on the partial-overlap trace"
+
+    qp = quantize_params(params)
+    q_refs = [np.asarray(generate(qp, args, t["prompt"][None],
+                                  max_new_tokens=t["max_new_tokens"]))[0]
+              for t in trace]
+    run(qp, "radix", check=q_refs)           # int8-WEIGHTS exact parity
+
+    return {
+        "trace": trace_stats(trace),
+        "page_size": ps,
+        "radix": radix,
+        "hash": hash_,
+        "hit_ratio_radix_over_hash": round(ratio, 3),
+        "int8_weights_parity": "exact",
+    }
+
+
+def _bench_int8_kv_pool(params, args, backend, seed):
+    """Equal-HBM capacity leg for the int8 KV page pool: the model-dtype
+    pool and the kv_dtype='int8' pool get the SAME KV byte budget (the
+    int8 pool converts it into ~itemsize x more pages) and replay the
+    same admission-bound trace. Asserts IN-LEG: >= 1.8x sustained slots
+    and per-request top-1 agreement >= _INT8_KV_AGREEMENT_BAR."""
+    from paddle_tpu.serving import PagedEngine
+    from tools.serving_trace import make_trace, trace_stats
+
+    if backend == "tpu":
+        ps, max_len, slots, base_pages, min_bucket = 64, 1024, 24, 48, 64
+        trace = make_trace(seed=seed, n_requests=48,
+                           mean_interarrival_steps=0.25,
+                           prompt_len_choices=(192, 256, 320),
+                           new_tokens_choices=(64,),
+                           vocab_size=args.vocab_size)
+    else:
+        ps, max_len, slots, base_pages, min_bucket = 8, 64, 12, 10, 8
+        trace = make_trace(seed=seed, n_requests=24,
+                           mean_interarrival_steps=0.25,
+                           prompt_len_choices=(9, 12, 17, 21),
+                           new_tokens_choices=(8,),
+                           vocab_size=args.vocab_size)
+
+    def run(num_pages, kv_dtype):
+        eng = PagedEngine(params, args, max_slots=slots, max_len=max_len,
+                          page_size=ps, num_pages=num_pages,
+                          min_bucket=min_bucket, kv_dtype=kv_dtype)
+        eng.replay(trace)
+        eng.reset()
+        t0 = time.perf_counter()
+        reqs = eng.replay(trace)
+        dt = time.perf_counter() - t0
+        m = eng.metrics.summary()
+        return reqs, {
+            "num_pages": num_pages,
+            "kv_pool_bytes": int(m["gauges"]["kv_pool_bytes"]["value"]),
+            "tokens_per_sec": round(
+                sum(len(r.token_ids) for r in reqs) / dt, 1),
+            "max_sustained_slots": int(m["gauges"]["active_slots"]["max"]),
+        }
+
+    base_reqs, base = run(base_pages, None)
+    # same byte budget -> int8 page count (int8 codes + one f32 scale per
+    # (layer, page, kv-head) per pool; x2 for the K and V pools)
+    L, nkv = args.num_layers, args.num_kv_heads
+    hd = args.hidden_size // args.num_heads
+    int8_page_bytes = 2 * L * nkv * (ps * hd + 4)
+    int8_pages = base["kv_pool_bytes"] // int8_page_bytes
+    int8_reqs, int8 = run(int8_pages, "int8")
+    assert int8["kv_pool_bytes"] <= base["kv_pool_bytes"]
+
+    agreement = [
+        float(np.mean(np.asarray(a.token_ids) == np.asarray(b.token_ids)))
+        if len(a.token_ids) == len(b.token_ids) else 0.0
+        for a, b in zip(int8_reqs, base_reqs)]
+    assert min(agreement) >= _INT8_KV_AGREEMENT_BAR, \
+        f"int8 KV top-1 agreement {min(agreement):.2f} < " \
+        f"{_INT8_KV_AGREEMENT_BAR} vs the model-dtype pool"
+    ratio = (int8["max_sustained_slots"]
+             / max(base["max_sustained_slots"], 1))
+    assert ratio >= 1.8, \
+        f"int8 sustained-slot ratio {ratio:.2f} < 1.8 at equal KV HBM"
+
+    return {
+        "trace": trace_stats(trace),
+        "page_size": ps,
+        "kv_budget_bytes": base["kv_pool_bytes"],
+        "model_dtype_pool": base,
+        "int8_pool": int8,
+        "sustained_slot_ratio": round(ratio, 2),
+        "top1_agreement_min": round(min(agreement), 4),
+        "top1_agreement_mean": round(float(np.mean(agreement)), 4),
+        "top1_agreement_bar": _INT8_KV_AGREEMENT_BAR,
+    }
+
+
+def _bench_paged_kernels_tpu(params, args, backend, seed):
+    """TPU kernel microbench (ROADMAP 2 measurement debt): per-step time,
+    tokens/sec and HBM-roofline-% for contiguous (stripe) decode
+    attention vs the paged kernel vs the int8-pool paged kernel, plus a
+    sharded TP decode step when >1 device is attached. Decode attention
+    is KV-stream bound, so roofline-% = KV bytes read / (dt * peak BW).
+    On CPU this leg records an EXPLICIT skip marker — never fake numbers
+    (the engine-level chunked-prefill / speculative tokens/sec live in
+    the --serving legs of the same record)."""
+    import jax
+    import jax.numpy as jnp
+
+    if backend != "tpu":
+        return {"skipped": True,
+                "reason": f"paged-kernel measurement requires a TPU "
+                          f"backend; this run is '{backend}'"}
+
+    from paddle_tpu.kernels import quantized_matmul as qm
+
+    kind = jax.devices()[0].device_kind
+    peak_bw = _peak_for(kind, _PEAK_HBM_BW)
+    b, nh, nkv, hd, ps, P = 8, 16, 16, 128, 64, 16
+    NP = b * P + 1
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(b, 1, nh, hd)), jnp.bfloat16)
+    pool = lambda: jnp.asarray(
+        rng.normal(size=(NP, nkv, ps, hd)), jnp.bfloat16)
+    k16, v16 = pool(), pool()
+    k8 = jnp.asarray(rng.integers(-127, 128, (NP, nkv, ps, hd)), jnp.int8)
+    v8 = jnp.asarray(rng.integers(-127, 128, (NP, nkv, ps, hd)), jnp.int8)
+    ks = jnp.asarray(rng.uniform(0.5, 2.0, (NP, nkv)), jnp.float32)
+    bt = jnp.arange(1, NP, dtype=jnp.int32).reshape(b, P)
+    pos = jnp.full((b,), P * ps - 1, jnp.int32)
+    cache = lambda: jnp.asarray(
+        rng.normal(size=(b, nkv, P * ps, hd)), jnp.bfloat16)
+    ck, cv = cache(), cache()
+
+    def timed(fn, *a, iters=50):
+        out = fn(*a)
+        jax.block_until_ready(out)           # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*a)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters
+
+    with qm.fused_dispatch(enabled=True):
+        dt_stripe = timed(jax.jit(qm.decode_attention), q, ck, cv, pos)
+        dt_paged = timed(jax.jit(qm.paged_decode_attention),
+                         q, k16, v16, bt, pos)
+        dt_int8 = timed(
+            jax.jit(lambda *a: qm.paged_decode_attention(
+                a[0], a[1], a[2], a[3], a[4], k_scale=a[5], v_scale=a[6])),
+            q, k8, v8, bt, pos, ks, ks)
+
+    def leg(dt, kv_bytes):
+        out = {"step_ms": round(dt * 1e3, 4),
+               "tokens_per_sec": round(b / dt, 1),
+               "kv_gbps": round(kv_bytes / dt / 1e9, 1)}
+        if peak_bw:
+            out["hbm_roofline_pct"] = round(100 * kv_bytes / dt / peak_bw, 1)
+        return out
+
+    kv16 = 2 * b * P * ps * nkv * hd * 2     # K+V, bf16
+    kv8 = 2 * b * P * (ps * nkv * hd + nkv * 4)
+    out = {
+        "device_kind": kind,
+        "shape": {"b": b, "nh": nh, "nkv": nkv, "hd": hd,
+                  "page_size": ps, "pages_per_row": P},
+        "stripe_decode": leg(dt_stripe, kv16),
+        "paged_decode": leg(dt_paged, kv16),
+        "paged_decode_int8": leg(dt_int8, kv8),
+        "paged_vs_stripe": round(dt_stripe / dt_paged, 3),
+        "int8_vs_bf16_pool": round(dt_paged / dt_int8, 3),
+    }
+
+    if len(jax.devices()) > 1:
+        from jax.sharding import Mesh
+
+        from paddle_tpu.serving import PagedEngine, Request
+
+        mesh = Mesh(np.asarray(jax.devices()), ("mp",))
+        eng = PagedEngine(params, args, max_slots=8, max_len=1024,
+                          page_size=ps, min_bucket=64, mesh=mesh)
+        prompts = [rng.integers(1, args.vocab_size, 128).astype(np.int32)
+                   for _ in range(8)]
+        eng.serve([Request(p, 8) for p in prompts])    # warm + prefix-cache
+        t0 = time.perf_counter()
+        reqs = eng.serve([Request(p, 64) for p in prompts])
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.token_ids) for r in reqs)
+        out["tp_decode"] = {"devices": len(jax.devices()),
+                            "tokens_per_sec": round(toks / dt, 1)}
+    else:
+        out["tp_decode"] = {"skipped": True,
+                            "reason": "single-device run: no mp axis"}
+    return out
+
+
+def _bench_serving_capacity(seed=0):
+    """The r6 serving-capacity record: radix-vs-hash prefix caching,
+    int8-KV equal-HBM sustained slots, and the TPU-gated paged-kernel
+    microbench. Runs on EVERY backend — the CPU model is tiny and the
+    TPU-only kernel fields carry an explicit skip marker on CPU."""
+    import signal
+
+    def _stuck(signum, frame):
+        print("BENCH_CAPACITY_TIMEOUT", flush=True)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, _stuck)
+    signal.alarm(1400)
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama_functional as lf
+
+    backend = jax.default_backend()
+    if backend == "tpu":
+        from paddle_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16,
+                          max_position_embeddings=2048)
+        args = lf.LlamaArgs.from_config(cfg)
+        params = lf.init_params(args, jax.random.key(0), jnp.bfloat16)
+    else:
+        args = lf.LlamaArgs(vocab_size=512, hidden_size=128,
+                            intermediate_size=352, num_layers=2,
+                            num_heads=4, num_kv_heads=2, rope_theta=1e4,
+                            rms_eps=1e-6, use_flash=False)
+        params = lf.init_params(args, jax.random.key(0))
+
+    out = {
+        "backend": backend,
+        "radix_prefix": _bench_radix_prefix(params, args, backend, seed),
+        "int8_kv_pool": _bench_int8_kv_pool(params, args, backend, seed),
+        "paged_kernels_tpu": _bench_paged_kernels_tpu(params, args,
+                                                      backend, seed),
+    }
+    print("BENCH_CAPACITY " + json.dumps(out))
+    return out
+
+
 def _bench_resnet_fit(batch=64, size=224, iters=24, warmup_iters=4):
     """Config 2 (BASELINE): ResNet-50 through `paddle.Model.fit` — the
     hapi high-level loop (reference model.py:1472), synthetic ImageNet-shaped
@@ -1149,27 +1454,28 @@ def main(telemetry_out=None):
         # BASELINE configs 2/3/5 (this round's done-criterion): every
         # remaining BASELINE.md config gets a measured leg. Same subprocess
         # isolation as the headline; a failed leg costs only its own entry.
-        for flag, tag, key in (
-                ("--baseline-resnet", "BENCH_RESNET ", "resnet50_fit"),
-                ("--baseline-bert", "BENCH_BERT ", "bert_zero2"),
-                ("--baseline-unet", "BENCH_UNET ", "sd_unet_predictor")):
-            try:
-                out = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__), flag]
-                    + _tele_args(key),
-                    capture_output=True, text=True, timeout=1500,
-                    cwd=os.path.dirname(os.path.abspath(__file__)))
-                for line in out.stdout.splitlines():
-                    if line.startswith(tag):
-                        record.setdefault("baseline_configs", {})[key] = \
-                            json.loads(line[len(tag):])
-                        _collect_leg(key)
-                        break
-                else:
-                    print(f"{key} bench failed:\n{out.stderr[-2000:]}",
-                          file=sys.stderr)
-            except subprocess.TimeoutExpired:
-                print(f"{key} bench timed out", file=sys.stderr)
+        _run_baseline_legs(record, _tele_args, _collect_leg)
+
+    # serving-capacity legs (the r6 tentpole: radix prefix cache + int8 KV
+    # pool) run on EVERY backend — the CPU model is tiny, and the TPU-only
+    # paged-kernel fields carry an explicit skip marker on CPU
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--serving-capacity"]
+            + _tele_args("serving_capacity"),
+            capture_output=True, text=True, timeout=1500,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in out.stdout.splitlines():
+            if line.startswith("BENCH_CAPACITY "):
+                record["serving_capacity"] = json.loads(
+                    line[len("BENCH_CAPACITY "):])
+                _collect_leg("serving_capacity")
+                break
+        else:
+            print(f"serving-capacity bench failed:\n{out.stderr[-2000:]}",
+                  file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print("serving-capacity bench timed out", file=sys.stderr)
 
     if telemetry_out:
         write_telemetry(telemetry_out, record, legs=leg_metrics)
@@ -1179,6 +1485,30 @@ def main(telemetry_out=None):
             shutil.rmtree(tele_dir, ignore_errors=True)
     print(json.dumps(record))
     return 0
+
+
+def _run_baseline_legs(record, _tele_args, _collect_leg):
+    for flag, tag, key in (
+            ("--baseline-resnet", "BENCH_RESNET ", "resnet50_fit"),
+            ("--baseline-bert", "BENCH_BERT ", "bert_zero2"),
+            ("--baseline-unet", "BENCH_UNET ", "sd_unet_predictor")):
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), flag]
+                + _tele_args(key),
+                capture_output=True, text=True, timeout=1500,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+            for line in out.stdout.splitlines():
+                if line.startswith(tag):
+                    record.setdefault("baseline_configs", {})[key] = \
+                        json.loads(line[len(tag):])
+                    _collect_leg(key)
+                    break
+            else:
+                print(f"{key} bench failed:\n{out.stderr[-2000:]}",
+                      file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"{key} bench timed out", file=sys.stderr)
 
 
 def write_telemetry(path, record, legs=None, registry=None):
@@ -1222,6 +1552,8 @@ if __name__ == "__main__":
         _rec = _bench_int8_decode()
     elif _argv == ["--serving"]:
         _rec = _bench_serving()
+    elif _argv == ["--serving-capacity"]:
+        _rec = _bench_serving_capacity()
     elif _argv == ["--baseline-resnet"]:
         _rec = _bench_resnet_fit()
     elif _argv == ["--baseline-bert"]:

@@ -42,6 +42,13 @@ GOLDEN_COLLECTIVES = {
     "paged_decode": (2, _TP_FP),
     "spec_verify": (2, _TP_FP),
     "page_copy": (0, _EMPTY_FP),
+    # kv_dtype='int8' family: quantize-at-scatter / dequant-at-gather are
+    # elementwise per shard, so the census must be IDENTICAL to the
+    # model-dtype pool — and the int8 page copy (codes + scale leaves)
+    # stays collective-free
+    "paged_prefill_int8": (2, _TP_FP),
+    "paged_decode_int8": (2, _TP_FP),
+    "page_copy_int8": (0, _EMPTY_FP),
 }
 
 # largest-intermediate ceilings at the toy geometry (measured max plus
@@ -55,6 +62,12 @@ BYTE_CEILINGS = {
     "paged_decode": 26 * 1024,
     "spec_verify": 26 * 1024,
     "page_copy": 26 * 1024,
+    # int8 pool: the pool buffers shrink 2-4x but the prefill gather
+    # dequantizes pages to f32 before attention, so the ceilings stay at
+    # the model-dtype budget rather than scaling with the pool
+    "paged_prefill_int8": 26 * 1024,
+    "paged_decode_int8": 26 * 1024,
+    "page_copy_int8": 26 * 1024,
 }
 
 _TRAIN_ARG_NAMES = ("params", "opt_state", "ids", "labels")

@@ -274,6 +274,28 @@ def serving_programs(tp=2, num_heads=None):
         donated=(0, 1), meta=meta)
     donated = {"paged_prefill": (6, 7), "paged_decode": (2, 3)}
 
+    # int8-pool engine: the same step family over QuantizedKVPage pools
+    # (int8 codes + per-(page, kv-head) scales). Quantize-at-scatter and
+    # dequant-at-gather must not change the collective structure (still
+    # the 2 row-parallel psums per scanned layer body), and the int8
+    # page copy must stay pure data movement over BOTH leaves.
+    eng8 = PagedEngine(params, args, kv_dtype="int8", **kw)
+    recs8 = {
+        "paged_prefill_int8": _Recorder(eng8._prefill_v[False]),
+        "paged_decode_int8": _Recorder(eng8._decode_v[False]),
+    }
+    eng8._prefill_v[False] = recs8["paged_prefill_int8"]
+    eng8._decode_v[False] = recs8["paged_decode_int8"]
+    eng8.serve([Request(prompt(16), max_new_tokens=4),
+                Request(prompt(10), max_new_tokens=3)])
+    copy8 = (_sds_tree(eng8._pk), _sds_tree(eng8._pv), i32, i32)
+    out["page_copy_int8"] = _from_traced(
+        "page_copy_int8", eng8._copy_page.trace(*copy8), copy8,
+        donated=(0, 1), meta=meta)
+    recs.update(recs8)
+    donated["paged_prefill_int8"] = (6, 7)
+    donated["paged_decode_int8"] = (2, 3)
+
     # draft engine: the speculative verify program (plain decode is
     # replaced by propose/verify rounds when a draft is loaded)
     draft_params, draft_args = gen.draft_from_params(params, args,

@@ -574,7 +574,9 @@ def paged_decode_supported(q_shape, pool_shape, bt_shape, itemsize=2):
     """True when the Pallas paged kernel can take q [b, 1, nh, hd] against
     a page pool [num_pages, nkv, page_size, hd] via block tables [b, P]:
     single query, query heads a multiple of kv heads, page_size a
-    sublane-tileable multiple and hd lane-aligned, working set in VMEM."""
+    sublane-tileable multiple and hd lane-aligned, working set in VMEM.
+    `itemsize` is the POOL element width — int8 pools (itemsize 1) need
+    page_size % 32 == 0 (the int8 sublane minimum)."""
     if len(q_shape) != 4 or q_shape[1] != 1:
         return False
     if len(pool_shape) != 4 or len(bt_shape) != 2:
@@ -590,8 +592,54 @@ def paged_decode_supported(q_shape, pool_shape, bt_shape, itemsize=2):
     return per_step <= _VMEM_BUDGET_BYTES
 
 
+def _paged_decode_kernel_q8(pos_ref, bt_ref, q_ref, k_ref, v_ref, sk_ref,
+                            sv_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                            page_size, sm_scale):
+    # int8-pool variant of `_paged_decode_kernel`: k/v blocks arrive as
+    # int8 PAGES with this page's per-(page, kv-head) absmax in sk/sv
+    # (1, 1) blocks routed through the same block-table index map. The
+    # dequant is the PR-1 in-registers pattern — int8 upcasts between the
+    # DMA and the MXU (exact in bf16), and the page's scale folds into
+    # the score scale (k) and the accumulator contribution (v), so a
+    # full-width page never exists outside registers.
+    bi, j = pl.program_id(0), pl.program_id(2)
+    pos = pos_ref[bi]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(j * page_size <= pos)
+    def _page():
+        q = q_ref[0, 0]                       # [g, d]
+        k = k_ref[0, 0].astype(q.dtype)       # int8 -> compute dtype, exact
+        v = v_ref[0, 0].astype(q.dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        s = s * (sk_ref[0, 0] * (sm_scale / 127.0))          # [g, ps]
+        cols = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(cols <= pos, s, _NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * (sv_ref[0, 0] / 127.0)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
 def _paged_decode_attention_pallas(q, pool_k, pool_v, block_tables, pos,
-                                   sm_scale, interpret):
+                                   sm_scale, interpret, k_scale=None,
+                                   v_scale=None):
     b, _, nh, hd = q.shape
     nkv, ps = pool_k.shape[1], pool_k.shape[2]
     P = block_tables.shape[1]
@@ -606,15 +654,27 @@ def _paged_decode_attention_pallas(q, pool_k, pool_v, block_tables, pos,
         jj = jnp.minimum(j, pos_ref[bi] // ps)
         return (bt_ref[bi, jj], hi, 0, 0)
 
+    def sc_map(bi, hi, j, pos_ref, bt_ref):
+        jj = jnp.minimum(j, pos_ref[bi] // ps)
+        return (bt_ref[bi, jj], hi)
+
+    quantized = k_scale is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, g, hd),
+                     lambda bi, hi, j, pos_ref, bt_ref: (bi, hi, 0, 0)),
+        pl.BlockSpec((1, 1, ps, hd), kv_map),
+        pl.BlockSpec((1, 1, ps, hd), kv_map),
+    ]
+    operands = [q4, pool_k, pool_v]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1), sc_map), pl.BlockSpec((1, 1),
+                                                                sc_map)]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, nkv, P),
-        in_specs=[
-            pl.BlockSpec((1, 1, g, hd),
-                         lambda bi, hi, j, pos_ref, bt_ref: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, ps, hd), kv_map),
-            pl.BlockSpec((1, 1, ps, hd), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, g, hd),
                                lambda bi, hi, j, pos_ref, bt_ref:
                                (bi, hi, 0, 0)),
@@ -622,35 +682,47 @@ def _paged_decode_attention_pallas(q, pool_k, pool_v, block_tables, pos,
                         pltpu.VMEM((g, 1), jnp.float32),
                         pltpu.VMEM((g, 1), jnp.float32)],
     )
+    kernel = _paged_decode_kernel_q8 if quantized else _paged_decode_kernel
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, page_size=ps,
-                          sm_scale=sm_scale),
+        functools.partial(kernel, page_size=ps, sm_scale=sm_scale),
         out_shape=jax.ShapeDtypeStruct((b, nkv, g, hd), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(pos_arr, bt_arr, q4, pool_k, pool_v)
+    )(pos_arr, bt_arr, *operands)
     return out.reshape(b, nh, hd)[:, None]
 
 
-def paged_gather(pool, block_tables):
+def paged_gather(pool, block_tables, scale=None, out_dtype=None):
     """Gather a pool [num_pages, nkv, page_size, hd] through block tables
     [b, P] into the contiguous per-row cache layout [b, nkv, P*ps, hd] —
     the jnp fallback path and the parity oracle for the paged kernel
-    (pages laid out in table order ARE the row's sequence)."""
+    (pages laid out in table order ARE the row's sequence). With `scale`
+    [num_pages, nkv] the pool is int8 and the gather dequantizes
+    (q * scale / 127) into `out_dtype` (default f32) — the oracle for the
+    int8 kernel's in-registers dequant."""
     b, P = block_tables.shape
     nkv, ps, hd = pool.shape[1], pool.shape[2], pool.shape[3]
     g = jnp.swapaxes(pool[block_tables], 1, 2)   # [b, nkv, P, ps, hd]
+    if scale is not None:
+        sc = jnp.swapaxes(scale[block_tables], 1, 2)   # [b, nkv, P]
+        g = (g.astype(jnp.float32)
+             * (sc / 127.0)[..., None, None]).astype(out_dtype
+                                                     or jnp.float32)
+    elif out_dtype is not None:
+        g = g.astype(out_dtype)
     return g.reshape(b, nkv, P * ps, hd)
 
 
 def _paged_decode_attention_xla(q, pool_k, pool_v, block_tables, pos,
-                                sm_scale):
-    return _decode_attention_xla(q, paged_gather(pool_k, block_tables),
-                                 paged_gather(pool_v, block_tables),
-                                 pos, sm_scale)
+                                sm_scale, k_scale=None, v_scale=None):
+    return _decode_attention_xla(
+        q, paged_gather(pool_k, block_tables, k_scale, q.dtype),
+        paged_gather(pool_v, block_tables, v_scale, q.dtype),
+        pos, sm_scale)
 
 
-def paged_decode_attention(q, pool_k, pool_v, block_tables, pos, scale=None):
+def paged_decode_attention(q, pool_k, pool_v, block_tables, pos, scale=None,
+                           k_scale=None, v_scale=None):
     """Single-query attention of q [b, 1, nh, hd] over a PAGED KV cache:
     pool_k/pool_v [num_pages, nkv, page_size, hd] indexed through per-row
     block tables [b, P] (page i of row r holds that row's positions
@@ -658,15 +730,21 @@ def paged_decode_attention(q, pool_k, pool_v, block_tables, pos, scale=None):
     point anywhere valid (the null page); the position mask keeps them
     unread. Pallas on TPU (per-row page-index prefetch: the block-table
     lookup happens in the BlockSpec index map, so K/V stream page-by-page
-    straight from HBM with no contiguous copy), jnp gather elsewhere."""
+    straight from HBM with no contiguous copy), jnp gather elsewhere.
+
+    k_scale/v_scale [num_pages, nkv]: the pools are int8 pages with
+    per-(page, kv-head) absmax scales — the kernel dequantizes
+    in-registers (q * scale / 127) so the HBM stream stays 1 byte/elem;
+    the fallback dequantizes in the gather."""
     sm_scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     use_pallas, interpret = _mode()
     if use_pallas and paged_decode_supported(q.shape, pool_k.shape,
                                              jnp.shape(block_tables),
-                                             q.dtype.itemsize):
+                                             pool_k.dtype.itemsize):
         try:
             return _paged_decode_attention_pallas(
-                q, pool_k, pool_v, block_tables, pos, sm_scale, interpret)
+                q, pool_k, pool_v, block_tables, pos, sm_scale, interpret,
+                k_scale=k_scale, v_scale=v_scale)
         except Exception as e:  # lowering constraints supports() can't model
             import warnings
 
@@ -675,7 +753,7 @@ def paged_decode_attention(q, pool_k, pool_v, block_tables, pos, scale=None):
                 f"{e}); falling back to the XLA gather for q={q.shape} "
                 f"pool={pool_k.shape}")
     return _paged_decode_attention_xla(q, pool_k, pool_v, block_tables, pos,
-                                       sm_scale)
+                                       sm_scale, k_scale, v_scale)
 
 
 def decode_attention(q, cache_k, cache_v, pos, scale=None, block_k=None):

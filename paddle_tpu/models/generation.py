@@ -40,8 +40,8 @@ from paddle_tpu.models import llama_functional as lf
 
 __all__ = ["generate", "params_from_layer", "prefill", "decode_step",
            "paged_decode_step", "gpt_generate", "gpt_params_from_layer",
-           "GPTGenArgs", "QuantizedWeight", "quantize_params",
-           "draft_from_params"]
+           "GPTGenArgs", "QuantizedWeight", "QuantizedKVPage",
+           "quantize_params", "draft_from_params"]
 
 
 class QuantizedWeight(NamedTuple):
@@ -52,6 +52,45 @@ class QuantizedWeight(NamedTuple):
 
     q: jax.Array
     scale: jax.Array
+
+
+class QuantizedKVPage(NamedTuple):
+    """int8 KV page-pool half: `q` int8 [..., num_pages, nkv, page_size,
+    hd] with per-(page, kv-head) absmax `scale` [..., num_pages, nkv] f32
+    (dequant = q * scale / 127 — the QuantizedWeight convention). A
+    pytree node: the stacked [L, ...] pool slices per layer under
+    lax.scan exactly like the bf16 pool arrays, and jit donation /
+    shard_map specs treat (q, scale) as ONE pool operand — both leaves
+    shard on the nkv axis, so the bf16 `P(None, None, mp)` pool spec
+    applies to the pair as a pytree prefix unchanged."""
+
+    q: jax.Array
+    scale: jax.Array
+
+
+def _kv_quant_write(pool, page, off, new):
+    """Write one token's K or V rows `new` [b, nkv, hd] into an int8 page
+    pool at (page[r], :, off[r]) keeping the per-(page, kv-head) absmax
+    scale RUNNING: when a token's absmax exceeds the page's scale, the
+    page's existing codes are re-scaled in-registers (round(q*old/new))
+    before the write — no page is ever dequantized through HBM. Rows own
+    their target pages exclusively (the host COW gate), except the null
+    page 0, which is a garbage sink on every write path."""
+    q, scale = pool
+    b = page.shape[0]
+    newf = new.astype(jnp.float32)
+    tok_abs = jnp.max(jnp.abs(newf), axis=-1)              # [b, nkv]
+    # positions fill pages sequentially, so a write at offset 0 is always
+    # the page's FIRST live write — restart its running scale there
+    # instead of inheriting a stale absmax from the page's previous owner
+    # (pages return to the pool carrying old codes and scales)
+    old_s = jnp.where(off[:, None] == 0, 0.0, scale[page])  # [b, nkv]
+    new_s = jnp.maximum(old_s, tok_abs)
+    safe = jnp.maximum(new_s, 1e-9)
+    pg = q[page].astype(jnp.float32) * (old_s / safe)[:, :, None, None]
+    pg = pg.at[jnp.arange(b), :, off].set(newf / safe[..., None] * 127.0)
+    qpg = jnp.clip(jnp.round(pg), -127, 127).astype(jnp.int8)
+    return QuantizedKVPage(q.at[page].set(qpg), scale.at[page].set(new_s))
 
 
 def _quantize_weight(w):
@@ -325,20 +364,31 @@ def _layer_step_paged(lp, h, pool_k_l, pool_v_l, bt, pos, cos, sin, args,
     # live page
     page = jnp.take_along_axis(bt, (pos // ps)[:, None], axis=1)[:, 0]
     off = pos % ps
-    pool_k_l = pool_k_l.at[page, :, off].set(k[:, 0])
-    pool_v_l = pool_v_l.at[page, :, off].set(v[:, 0])
+    quantized = isinstance(pool_k_l, QuantizedKVPage)
+    if quantized:
+        pool_k_l = _kv_quant_write(pool_k_l, page, off, k[:, 0])
+        pool_v_l = _kv_quant_write(pool_v_l, page, off, v[:, 0])
+        kq, ks = pool_k_l
+        vq, vs = pool_v_l
+    else:
+        pool_k_l = pool_k_l.at[page, :, off].set(k[:, 0])
+        pool_v_l = pool_v_l.at[page, :, off].set(v[:, 0])
+        kq, ks, vq, vs = pool_k_l, None, pool_v_l, None
 
     from paddle_tpu.kernels import quantized_matmul as qm
 
     if qm.fused_enabled() and qm.paged_decode_supported(
-            q.shape, pool_k_l.shape, bt.shape, q.dtype.itemsize):
-        attn = qm.paged_decode_attention(q, pool_k_l, pool_v_l, bt, pos)
+            q.shape, kq.shape, bt.shape, kq.dtype.itemsize):
+        attn = qm.paged_decode_attention(q, kq, vq, bt, pos,
+                                         k_scale=ks, v_scale=vs)
     else:
-        # gather pages into the contiguous per-row layout and reuse the
-        # stripe attention (jnp mask fallback; contiguous Pallas kernel if
-        # eligible) — table order IS sequence order, so positions line up
-        attn = _cached_attention(q, qm.paged_gather(pool_k_l, bt),
-                                 qm.paged_gather(pool_v_l, bt), pos)
+        # gather pages into the contiguous per-row layout (dequantized
+        # under an int8 pool) and reuse the stripe attention (jnp mask
+        # fallback; contiguous Pallas kernel if eligible) — table order
+        # IS sequence order, so positions line up
+        attn = _cached_attention(
+            q, qm.paged_gather(kq, bt, scale=ks, out_dtype=q.dtype),
+            qm.paged_gather(vq, bt, scale=vs, out_dtype=q.dtype), pos)
     h = h + _tp_reduce(_wmm(attn.reshape(b, 1, nh * hd), lp["wo"]), tp_axis)
 
     hin = lf.rms_norm(h, lp["ln2"], args.rms_eps)
@@ -380,10 +430,23 @@ def _layer_step_paged_verify(lp, h, pool_k_l, pool_v_l, bt, pos, limit,
     page = jnp.take_along_axis(bt, prow // ps, axis=1)       # [b, s]
     page = jnp.where(prow <= limit[:, None], page, 0)        # null-page sink
     off = prow % ps
-    pool_k_l = pool_k_l.at[page.reshape(-1), :, off.reshape(-1)].set(
-        k.reshape(b * s, nkv, hd))
-    pool_v_l = pool_v_l.at[page.reshape(-1), :, off.reshape(-1)].set(
-        v.reshape(b * s, nkv, hd))
+    if isinstance(pool_k_l, QuantizedKVPage):
+        # token-at-a-time running-absmax writes (s is tiny — the draft
+        # window) so a window straddling a page boundary re-scales each
+        # touched page exactly once per token that exceeds its scale
+        for i in range(s):
+            pool_k_l = _kv_quant_write(pool_k_l, page[:, i], off[:, i],
+                                       k[:, i])
+            pool_v_l = _kv_quant_write(pool_v_l, page[:, i], off[:, i],
+                                       v[:, i])
+        kq, ks = pool_k_l
+        vq, vs = pool_v_l
+    else:
+        pool_k_l = pool_k_l.at[page.reshape(-1), :, off.reshape(-1)].set(
+            k.reshape(b * s, nkv, hd))
+        pool_v_l = pool_v_l.at[page.reshape(-1), :, off.reshape(-1)].set(
+            v.reshape(b * s, nkv, hd))
+        kq, ks, vq, vs = pool_k_l, None, pool_v_l, None
 
     from paddle_tpu.kernels import quantized_matmul as qm
 
@@ -392,8 +455,9 @@ def _layer_step_paged_verify(lp, h, pool_k_l, pool_v_l, bt, pos, limit,
     # s is tiny (draft length + 1), so gather-then-mask is the dispatch on
     # every backend; a fused window kernel is a follow-up once
     # TPU-measured numbers justify it
-    attn = _cached_attention(q, qm.paged_gather(pool_k_l, bt),
-                             qm.paged_gather(pool_v_l, bt), pos)
+    attn = _cached_attention(
+        q, qm.paged_gather(kq, bt, scale=ks, out_dtype=q.dtype),
+        qm.paged_gather(vq, bt, scale=vs, out_dtype=q.dtype), pos)
     h = h + _tp_reduce(_wmm(attn.reshape(b, s, nh * hd), lp["wo"]), tp_axis)
 
     hin = lf.rms_norm(h, lp["ln2"], args.rms_eps)
@@ -457,7 +521,10 @@ def paged_decode_step(params, args, token, pool_k, pool_v, block_tables,
     independent; unused/inactive table entries must point at a valid page
     index (conventionally the null page 0) and are never read thanks to
     the position mask. float and `quantize_params` int8 trees both work —
-    every matmul rides the fused dequant-matmul dispatch."""
+    every matmul rides the fused dequant-matmul dispatch — and the pools
+    may be `QuantizedKVPage` pairs (int8 pages + per-(page, kv-head)
+    scales): writes then quantize in place and attention dequantizes
+    in-registers."""
     hd = args.hidden_size // args.num_heads
     P = block_tables.shape[1]
     cos, sin = lf.rope_tables(P * int(page_size), hd, args.rope_theta)

@@ -6,12 +6,18 @@ Two engines share one iteration-level scheduler (Orca-style):
     stripe per slot. Simple, but HBM caps concurrency at S stripes.
   - `PagedEngine` (serving/paged_engine.py): paged KV cache — a fixed
     page pool + per-slot block tables (`serving/block_manager.py`:
-    refcounted pages, copy-on-write, LRU eviction) with HASH-BASED
-    PREFIX REUSE: full pages of every prefilled prompt are registered in
-    an exact-match hash chain, so a shared system prompt is prefilled
-    once and later requests start decoding after a block-table lookup.
-    Admission allocates pages on demand (worst case reserved up front),
-    so far more concurrent requests fit the same KV HBM.
+    refcounted pages, copy-on-write, leaf-LRU eviction) with RADIX-TREE
+    PREFIX REUSE: every prefilled prompt is registered in a radix tree
+    over token sequences, so a shared system prompt is prefilled once
+    and later requests reuse it at TOKEN granularity — a mid-page
+    divergence still shares the straddled page via a COW page split
+    (`prefix_policy="hash"` keeps the PR-8 exact-match chain as the
+    baseline). Admission allocates pages on demand (worst case reserved
+    up front), so far more concurrent requests fit the same KV HBM —
+    and `kv_dtype="int8"` quantizes the page pool itself (int8 codes +
+    per-(page, kv-head) absmax scales, dequantized inside the paged
+    kernel) for ~2x the pages again at the same byte budget, with a
+    top-1 agreement parity bar vs the model-dtype pool.
 
 The paged engine stacks the three serving-throughput levers (ISSUE 14),
 all preserving exact greedy parity with sequential `generate`:
@@ -56,7 +62,8 @@ stripe-vs-paged comparison at equal KV-cache HBM, a chunked-vs-
 monolithic TTFT leg, and a speculative-vs-greedy tokens/sec leg.
 """
 
-from paddle_tpu.serving.block_manager import NULL_PAGE, BlockAllocator
+from paddle_tpu.serving.block_manager import (NULL_PAGE, BlockAllocator,
+                                              PrefixMatch)
 from paddle_tpu.serving.engine import Engine, Request
 from paddle_tpu.serving.metrics import Metrics
 from paddle_tpu.serving.paged_engine import PagedEngine
@@ -66,5 +73,5 @@ from paddle_tpu.serving.scheduler import (AdmissionQueue, SlotTable,
 from paddle_tpu.serving.spec_decode import SpecDecoder
 
 __all__ = ["Engine", "PagedEngine", "Request", "Metrics", "BlockAllocator",
-           "NULL_PAGE", "AdmissionQueue", "SlotTable", "SlotSampler",
-           "SpecDecoder", "bucket_for", "pages_for"]
+           "PrefixMatch", "NULL_PAGE", "AdmissionQueue", "SlotTable",
+           "SlotSampler", "SpecDecoder", "bucket_for", "pages_for"]

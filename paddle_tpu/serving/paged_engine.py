@@ -11,9 +11,12 @@ scheduler:
   - ONE fixed page pool `[L, num_pages, nkv, page_size, hd]` (heads-major
     pages — the layout the Pallas paged decode kernel consumes) and a
     per-slot BLOCK TABLE mapping sequence positions to pages;
-  - PREFIX CACHE: full pages of every prefilled prompt are registered in
-    `BlockAllocator`'s exact-match hash chain and REF'd by later
-    requests sharing the prefix (refcounted, COW-protected);
+  - PREFIX CACHE: every prefilled prompt is registered in
+    `BlockAllocator`'s radix tree and REF'd by later requests sharing
+    the prefix at TOKEN granularity (refcounted, COW-protected; a
+    mid-page divergence shares the straddled page through a
+    copy-on-write split — the PR-8 exact-match hash chain survives as
+    `prefix_policy="hash"`, the bench baseline);
   - PREFILL = gather the hit pages, run the suffix forward at traced
     position h (one program per suffix-length bucket), scatter the new
     pages; DECODE = one batched paged step through the block tables;
@@ -86,23 +89,41 @@ def _paged_prefill_traced(params, ids, h, last_idx, bt_row, new_pages,
 
     ids: [1, sb] window right-padded to a length bucket; h: traced token
     count already cached (prefix hits AND previously prefilled chunks —
-    always a page multiple); last_idx: index of the window's last real
-    token WITHIN the block; bt_row/new_pages: [P] page indices (unused
-    entries -> null page 0). One XLA program per window bucket — h,
-    last_idx and the page vectors are traced operands, so neither hit
-    depth nor chunk position recompiles."""
+    TOKEN-granular under the radix cache, so h may sit mid-page: the
+    straddled page is gathered from the frozen cached page and the
+    scatter rewrites the slot's COW copy of it from the page-aligned
+    base); last_idx: index of the window's last real token WITHIN the
+    block; bt_row/new_pages: [P] page indices (unused entries -> null
+    page 0). One XLA program per window bucket — h, last_idx and the
+    page vectors are traced operands, so neither hit depth nor chunk
+    position recompiles."""
     metrics.inc("prefill_compiles")
-    L, nkv, hd = pk.shape[0], pk.shape[2], pk.shape[4]
+    quantized = isinstance(pk, gen.QuantizedKVPage)
+    arr = pk.q if quantized else pk
+    L, nkv, hd = arr.shape[0], arr.shape[2], arr.shape[4]
     ps, Pn = page_size, pages_per_slot
     sb = ids.shape[1]
-    dtype = pk.dtype
+    dtype = params["embedding"].dtype if quantized else pk.dtype
 
     # gather the block-table row into contiguous [L, 1, nkv, P*ps, hd]
     # (hit pages carry real prefix K/V; later entries are garbage that the
     # suffix writes + position mask keep unread), then pad by the suffix
-    # bucket so the write at [h, h+sb) can never clamp
-    g_k = jnp.swapaxes(pk[:, bt_row], 1, 2).reshape(L, 1, nkv, Pn * ps, hd)
-    g_v = jnp.swapaxes(pv[:, bt_row], 1, 2).reshape(L, 1, nkv, Pn * ps, hd)
+    # bucket so the write at [h, h+sb) can never clamp. An int8 pool
+    # dequantizes in the gather — the scratch stripe the forward runs
+    # over is always the compute dtype
+    if quantized:
+        def dq(pool):
+            raw = pool.q[:, bt_row].astype(jnp.float32)   # [L, P, nkv, ps, hd]
+            sc = (pool.scale[:, bt_row] / 127.0)[..., None, None]
+            return (raw * sc).astype(dtype)
+
+        g_k = jnp.swapaxes(dq(pk), 1, 2).reshape(L, 1, nkv, Pn * ps, hd)
+        g_v = jnp.swapaxes(dq(pv), 1, 2).reshape(L, 1, nkv, Pn * ps, hd)
+    else:
+        g_k = jnp.swapaxes(pk[:, bt_row], 1, 2).reshape(
+            L, 1, nkv, Pn * ps, hd)
+        g_v = jnp.swapaxes(pv[:, bt_row], 1, 2).reshape(
+            L, 1, nkv, Pn * ps, hd)
     pad = jnp.zeros((L, 1, nkv, sb, hd), dtype)
     temp_k = jnp.concatenate([g_k, pad], axis=3)
     temp_v = jnp.concatenate([g_v, pad], axis=3)
@@ -115,15 +136,44 @@ def _paged_prefill_traced(params, ids, h, last_idx, bt_row, new_pages,
     first = _pick(logits, sample, temp, top_p, top_k, seeds,
                   h + last_idx + 1)[0]
 
-    # scatter the newly computed pages (suffix positions [h + i*ps, ...))
-    # into the pool; unused entries land on the null page
+    # scatter the freshly written pages back from the page-aligned base
+    # below h: when h is mid-page the first chunk carries the gathered
+    # cached half [base, h) plus the new tokens — exactly the COW-copy
+    # content. Unused entries land on the null page.
+    base = h - h % ps
     def chunk(t, i):
-        return jax.lax.dynamic_slice_in_dim(t, h + i * ps, ps, axis=3)
+        return jax.lax.dynamic_slice_in_dim(t, base + i * ps, ps, axis=3)
 
     new_k = jnp.concatenate([chunk(temp_k, i) for i in range(Pn)], axis=1)
     new_v = jnp.concatenate([chunk(temp_v, i) for i in range(Pn)], axis=1)
-    pk = pk.at[:, new_pages].set(new_k)   # [L, P, nkv, ps, hd]
-    pv = pv.at[:, new_pages].set(new_v)
+    if quantized:
+        # scatter-time quantization: per-(page, kv-head) absmax over the
+        # VALID positions only — the scratch stripe beyond the window's
+        # last real token [end = h + last_idx + 1] is garbage (pad +
+        # forward junk) that would otherwise inflate the scale and crush
+        # the real values' precision. Masked positions store 0.
+        end = h + last_idx + 1
+        pos_abs = (base + (jnp.arange(Pn, dtype=jnp.int32) * ps)[:, None]
+                   + jnp.arange(ps, dtype=jnp.int32)[None, :])   # [Pn, ps]
+        valid = (pos_abs < end)[None, :, None, :, None]
+
+        def quant(newx):
+            x = jnp.where(valid, newx.astype(jnp.float32), 0.0)
+            s = jnp.max(jnp.abs(x), axis=(3, 4))                 # [L, Pn, nkv]
+            qx = jnp.clip(jnp.round(
+                x / jnp.maximum(s, 1e-9)[..., None, None] * 127.0),
+                -127, 127).astype(jnp.int8)
+            return qx, s
+
+        qk, sk = quant(new_k)
+        qv, sv = quant(new_v)
+        pk = gen.QuantizedKVPage(pk.q.at[:, new_pages].set(qk),
+                                 pk.scale.at[:, new_pages].set(sk))
+        pv = gen.QuantizedKVPage(pv.q.at[:, new_pages].set(qv),
+                                 pv.scale.at[:, new_pages].set(sv))
+    else:
+        pk = pk.at[:, new_pages].set(new_k)   # [L, P, nkv, ps, hd]
+        pv = pv.at[:, new_pages].set(new_v)
     return pk, pv, first
 
 
@@ -138,12 +188,15 @@ def _paged_decode_traced(params, tokens, pk, pv, bt, pos, cos, sin, temp,
 
 
 def _copy_page_traced(pk, pv, src, dst):
-    """Device half of copy-on-write: clone one page's K/V across layers."""
-    pk = jax.lax.dynamic_update_slice_in_dim(
-        pk, jax.lax.dynamic_slice_in_dim(pk, src, 1, axis=1), dst, axis=1)
-    pv = jax.lax.dynamic_update_slice_in_dim(
-        pv, jax.lax.dynamic_slice_in_dim(pv, src, 1, axis=1), dst, axis=1)
-    return pk, pv
+    """Device half of copy-on-write: clone one page's K/V across layers.
+    The page axis is axis 1 of every pool leaf — the bf16 arrays AND both
+    halves of an int8 `QuantizedKVPage` (codes [L, pages, ...] and scales
+    [L, pages, nkv]) — so one tree_map covers both pool layouts."""
+    def cp(a):
+        return jax.lax.dynamic_update_slice_in_dim(
+            a, jax.lax.dynamic_slice_in_dim(a, src, 1, axis=1), dst, axis=1)
+
+    return (jax.tree_util.tree_map(cp, pk), jax.tree_util.tree_map(cp, pv))
 
 
 class PagedEngine(Engine):
@@ -153,8 +206,9 @@ class PagedEngine(Engine):
 
     page_size: tokens per KV page. On TPU keep it a multiple of 16 (bf16
                sublane tile) with head_dim a multiple of 128 so the Pallas
-               paged decode kernel stays eligible; it is also the prefix-
-               cache granularity (only full pages are shared).
+               paged decode kernel stays eligible. Prefix sharing itself
+               is TOKEN-granular (radix cache); page_size only sets the
+               COW-copy unit a mid-page divergence pays for.
     num_pages: pool size INCLUDING the reserved null page 0. Defaults to
                max_slots * (max_len/page_size) + 1 — the stripe engine's
                capacity; set it lower to oversubscribe slots against the
@@ -175,13 +229,33 @@ class PagedEngine(Engine):
                decoding with `spec_tokens` drafts per round. Greedy
                requests only (exact-match acceptance); sampling requests
                are rejected at submit.
+    kv_dtype:  None (pool in the model dtype) or 'int8' — quantize the
+               KV page pool to int8 with per-(page, kv-head) absmax
+               scales (`generation.QuantizedKVPage`). Prefill scatters
+               quantize whole pages, decode/verify writes keep a RUNNING
+               absmax (re-scaling a page's codes in-registers when a new
+               token exceeds its scale), and attention dequantizes
+               inside the paged kernel — KV bytes halve vs bf16, so an
+               equal-HBM pool holds ~2x the pages. Outputs track the
+               bf16 pool to a top-1 agreement bar, not bit-exactly
+               (quantization perturbs KV); on TPU the int8 paged kernel
+               needs page_size % 32 == 0 and head_dim % 128 == 0, other
+               shapes ride the dequant-gather fallback.
     """
 
     def __init__(self, params, args, *, max_slots=4, max_len=256,
                  page_size=16, num_pages=None, min_bucket=16, pad_id=0,
                  metrics=None, mesh=None, tp_axis="mp", prefill_chunk=None,
                  draft_params=None, draft_args=None, spec_tokens=4,
-                 donate_steps=None):
+                 donate_steps=None, prefix_policy="radix", kv_dtype=None):
+        if prefix_policy not in ("radix", "hash"):
+            raise ValueError(f"prefix_policy={prefix_policy!r} must be "
+                             "'radix' or 'hash'")
+        self.prefix_policy = prefix_policy
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype={kv_dtype!r} must be None (the "
+                             "model dtype) or 'int8'")
+        self.kv_dtype = kv_dtype
         if max_len % page_size != 0:
             raise ValueError(
                 f"max_len={max_len} must be a multiple of "
@@ -255,11 +329,28 @@ class PagedEngine(Engine):
         L = lf.stack_leading_dim(self.params["layers"])
         hd = args.hidden_size // args.num_heads
         dtype = jax.tree_util.tree_leaves(self.params["embedding"])[0].dtype
-        self._pk = jnp.zeros(
-            (L, self.num_pages, args.num_kv_heads, self.page_size, hd),
-            dtype)
-        self._pv = jnp.zeros_like(self._pk)
+        nkv = args.num_kv_heads
+        pool_shape = (L, self.num_pages, nkv, self.page_size, hd)
+        if self.kv_dtype == "int8":
+            # int8 pages + per-(page, kv-head) absmax scales: halves (vs
+            # bf16) the KV bytes behind a page, so the same HBM budget
+            # holds ~2x the pages -> ~2x the sustained slots. Scales
+            # start at 0: the first write into a page sets them
+            self._pk = gen.QuantizedKVPage(
+                jnp.zeros(pool_shape, jnp.int8),
+                jnp.zeros((L, self.num_pages, nkv), jnp.float32))
+            self._pv = gen.QuantizedKVPage(
+                jnp.zeros(pool_shape, jnp.int8),
+                jnp.zeros((L, self.num_pages, nkv), jnp.float32))
+        else:
+            self._pk = jnp.zeros(pool_shape, dtype)
+            self._pv = jnp.zeros_like(self._pk)
+        self.metrics.set_gauge("kv_pool_bytes", 2 * sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(self._pk)))
         if self.mesh is not None:
+            # both halves of a QuantizedKVPage shard on nkv, so the bf16
+            # pool spec applies to the pair as a pytree prefix
             sh = NamedSharding(self.mesh, self._poolspec)
             self._pk = jax.device_put(self._pk, sh)
             self._pv = jax.device_put(self._pv, sh)
@@ -269,7 +360,8 @@ class PagedEngine(Engine):
                                               args.rope_theta)
 
         self._alloc = BlockAllocator(self.num_pages, self.page_size,
-                                     metrics=self.metrics)
+                                     metrics=self.metrics,
+                                     policy=self.prefix_policy)
         self._bt = [[] for _ in range(self.max_slots)]   # host block tables
         self._resv = {}            # slot -> pages still reserved for decode
         self._reserved_total = 0
@@ -332,19 +424,21 @@ class PagedEngine(Engine):
         return super().submit(req)
 
     def _peek_hits(self, req):
-        """Side-effect-free prefix-hit count for a queued request,
-        memoized on the allocator's prefix_version: the anti-convoy scan
-        below runs every step while a chunk stream is active, and
-        re-hashing every queued prompt each step is O(queue x prompt_len)
-        host work for an answer that only changes when the prefix table
-        does."""
+        """Side-effect-free PrefixMatch for a queued request, memoized
+        on the allocator's prefix_version: the anti-convoy scan below
+        runs every step while a chunk stream is active, and re-walking
+        every queued prompt each step is O(queue x prompt_len) host work
+        for an answer that only changes when the prefix index does. Any
+        registration, split, or eviction bumps prefix_version and
+        invalidates the memo — a stale hit set here would skew the
+        worst-case page reservation `_can_prefill` gates admission on."""
         ver = self._alloc.prefix_version
         cached = getattr(req, "_hits_memo", None)
         if cached is not None and cached[0] == ver:
             return cached[1]
-        hits = len(self._alloc.match_prefix(req.prompt_ids, commit=False))
-        req._hits_memo = (ver, hits)
-        return hits
+        peek = self._alloc.match_prefix(req.prompt_ids, commit=False)
+        req._hits_memo = (ver, peek)
+        return peek
 
     def _admission_index(self):
         """Queue index to admit next. FIFO — except while a chunk stream
@@ -359,7 +453,7 @@ class PagedEngine(Engine):
             return 0
         for i in range(len(self.queue)):
             req = self.queue.peek_at(i)
-            if (req.prompt_ids.size - self._peek_hits(req) * self.page_size
+            if (req.prompt_ids.size - self._peek_hits(req).matched
                     <= self.prefill_chunk):
                 return i
         return 0
@@ -373,12 +467,17 @@ class PagedEngine(Engine):
         # queued prompt, which is too much host work to repeat per step
         self._admit_idx = self._admission_index()
         req = self.queue.peek_at(self._admit_idx)
-        hits = self._alloc.match_prefix(req.prompt_ids, commit=False)
+        peek = self._peek_hits(req)
         # reviving a cached (refcount-0) hit consumes availability just
-        # like a fresh alloc; an actively shared hit is free
-        revive = sum(1 for p in hits if self._alloc.refcount(p) == 0)
+        # like a fresh alloc; an actively shared hit is free. A mid-page
+        # partial hit nets out: its COW copy costs one alloc but saves
+        # one page of suffix — so `need` stays pages_for - full_hits.
+        hit_pages = list(peek.pages)
+        if peek.partial_page is not None:
+            hit_pages.append(peek.partial_page)
+        revive = sum(1 for p in hit_pages if self._alloc.refcount(p) == 0)
         need = (pages_for(req.prompt_ids.size, req.max_new_tokens,
-                          self.page_size) - len(hits) + revive)
+                          self.page_size) - len(peek.pages) + revive)
         return need <= self._alloc.available - self._reserved_total
 
     # -- the interleaving scheduler -----------------------------------------
@@ -420,15 +519,27 @@ class PagedEngine(Engine):
         tail draws from it at page boundaries). Returns h — the cached
         token count the first window starts at."""
         ps = self.page_size
-        hits = self._alloc.match_prefix(req.prompt_ids)   # refs hit pages
-        h = len(hits) * ps
-        self._bt[slot] = list(hits)
-        resv = pages_for(n, req.max_new_tokens, ps) - len(hits)
+        hit = self._alloc.match_prefix(req.prompt_ids)   # refs hit pages
+        h = hit.matched
+        self._bt[slot] = list(hit.pages)
+        held = len(hit.pages)
+        if hit.partial_page is not None:
+            # mid-page hit: the straddled page is frozen (tree-registered),
+            # so take a copy-on-write split — ensure_writable swaps our ref
+            # for a fresh page and the page-copy program clones the device
+            # contents; the first window then overwrites [h, ...) in place
+            src = hit.partial_page
+            copy, _ = self._alloc.ensure_writable(src)
+            self._pk, self._pv = self._copy_page(
+                self._pk, self._pv, jnp.int32(src), jnp.int32(copy))
+            self._bt[slot].append(copy)
+            held += 1
+        resv = pages_for(n, req.max_new_tokens, ps) - held
         self._resv[slot] = resv
         self._reserved_total += resv
         self.metrics.inc("prompt_tokens", n)
         self.metrics.inc("prefix_tokens_hit", h)
-        self.metrics.inc("prefix_pages_hit", len(hits))
+        self.metrics.inc("prefix_pages_hit", len(hit.pages))
         self.metrics.inc("prefix_pages_queried", (n - 1) // ps)
         return h
 
@@ -440,7 +551,10 @@ class PagedEngine(Engine):
         in the prefix cache."""
         ps, Pn = self.page_size, self.pages_per_slot
         final = end == n
-        n_now = -(-end // ps) - start // ps           # pages this window
+        # pages this window adds beyond those already seated (hits, the
+        # partial-hit COW copy, earlier chunks); token-granular `start`
+        # makes this ceil(end/ps) minus the seated count
+        n_now = -(-end // ps) - len(self._bt[slot])
         new_pages = [self._alloc.alloc() for _ in range(n_now)]
         self._resv[slot] -= n_now
         self._reserved_total -= n_now
@@ -449,8 +563,13 @@ class PagedEngine(Engine):
 
         bt_row = np.zeros(Pn, np.int32)
         bt_row[:len(pages)] = pages
+        # every page the window touches gets scattered: the straddled
+        # page at start//ps (the mid-page-hit COW copy on the first
+        # window, the slot's own tail page on later chunks) is rewritten
+        # from the gathered stripe plus the new tokens
+        touched = pages[start // ps:]
         new_vec = np.full(Pn, NULL_PAGE, np.int32)
-        new_vec[:n_now] = new_pages
+        new_vec[:len(touched)] = touched
         sb = bucket_for(end - start, self.min_bucket, self.max_len)
         padded = np.full((1, sb), self.pad_id, np.int32)
         padded[0, :end - start] = req.prompt_ids[start:end]
@@ -465,7 +584,11 @@ class PagedEngine(Engine):
                 jnp.asarray([req.seed], jnp.int32))
             first = int(first)
         if final:
-            # make this prompt's full pages hittable for future requests
+            # make this prompt's FULL pages hittable right away (a
+            # concurrent identical prompt shares them while this one is
+            # still decoding). The partial tail page stays unregistered
+            # until _retire — decode keeps writing into it, and freezing
+            # it now would force an unreserved COW on the first decode
             self._alloc.register_prefix(req.prompt_ids, pages[:n // ps])
             # chunk-streamed prompts mirror into the draft window by
             # window instead (see _chunk_step) — one monolithic draft
@@ -590,6 +713,19 @@ class PagedEngine(Engine):
 
     # -- lifecycle ----------------------------------------------------------
     def _retire(self, slot):
+        # the slot stops writing here, so its partial PROMPT tail page is
+        # finally frozen: hang it on the radix tree (full pages were
+        # registered at prefill; this extends the cached prefix to token
+        # granularity — contents beyond the prompt are decode K/V that
+        # partial_len keeps unreachable). Only prompt positions are
+        # cached: their bytes came from prefill programs, so later hits
+        # replay the exact values a fresh prefill would compute.
+        req = self.slots.owner(slot)
+        if req is not None and int(self._npos[slot]) >= req.prompt_ids.size:
+            n = int(req.prompt_ids.size)
+            n_pages = -(-n // self.page_size)
+            self._alloc.register_prefix(req.prompt_ids,
+                                        self._bt[slot][:n_pages])
         for p in self._bt[slot]:
             self._alloc.release(p)
         self._bt[slot] = []
@@ -603,8 +739,13 @@ class PagedEngine(Engine):
         cache — a warm timed run after reset would be all hits and lie);
         compiled programs and compile counters survive."""
         super().reset()
+        # the page pool survives a reset, so its byte gauge must too
+        self.metrics.set_gauge("kv_pool_bytes", 2 * sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree_util.tree_leaves(self._pk)))
         self._alloc = BlockAllocator(self.num_pages, self.page_size,
-                                     metrics=self.metrics)
+                                     metrics=self.metrics,
+                                     policy=self.prefix_policy)
         self._bt = [[] for _ in range(self.max_slots)]
         self._resv = {}
         self._reserved_total = 0

@@ -20,7 +20,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["make_trace", "make_mixed_trace", "trace_stats"]
+__all__ = ["make_trace", "make_mixed_trace", "make_partial_overlap_trace",
+           "trace_stats"]
 
 
 def make_trace(seed=0, n_requests=24, mean_interarrival_steps=2.0,
@@ -65,6 +66,43 @@ def make_trace(seed=0, n_requests=24, mean_interarrival_steps=2.0,
         if eos_token_id is not None:
             entry["eos_token_id"] = int(eos_token_id)
         trace.append(entry)
+    return trace
+
+
+def make_partial_overlap_trace(seed=0, n_requests=12, base_len=22,
+                               divergence_points=(12,),
+                               suffix_len_choices=(5, 9, 13),
+                               new_tokens_choices=(8,),
+                               mean_interarrival_steps=1.0, vocab_size=128):
+    """PARTIAL-overlap trace — the radix-vs-hash discriminator. One seeded
+    BASE prompt of `base_len` tokens; each request truncates it at a
+    divergence point d (drawn from `divergence_points` + the full base)
+    and appends a unique suffix. Pick d values that are NOT multiples of
+    the engine's page size: a hash-chain prefix cache only matches whole
+    pages whose token content is identical, so it credits floor(d / ps) *
+    ps tokens per warm request, while token-granular radix matching
+    credits all d — the hit-rate gap is exactly the mid-page remainder
+    this trace engineers. Entries carry 'divergence' for per-class
+    accounting. Deterministic for a given seed."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(1, vocab_size, size=int(base_len)).astype(np.int32)
+    points = tuple(divergence_points) + (int(base_len),)
+    gaps = rng.exponential(mean_interarrival_steps, n_requests)
+    arrivals = np.floor(np.cumsum(gaps)).astype(int)
+    trace = []
+    for i in range(n_requests):
+        d = int(points[i % len(points)])
+        suffix = rng.integers(
+            1, vocab_size,
+            size=int(rng.choice(suffix_len_choices))).astype(np.int32)
+        trace.append({
+            "request_id": i,
+            "arrival_step": int(arrivals[i]),
+            "prompt": np.concatenate([base[:d], suffix]),
+            "max_new_tokens": int(rng.choice(new_tokens_choices)),
+            "shared_prefix": True,
+            "divergence": d,
+        })
     return trace
 
 
