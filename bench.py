@@ -1094,6 +1094,259 @@ def _bench_serving_capacity(seed=0):
     return out
 
 
+def _bench_serving_disagg(seed=0):
+    """The ISSUE-20 record: disaggregated prefill/decode + the SLO
+    router, on every backend.
+
+    Leg 1 (disagg): a steady decode stream runs on a `DecodeWorker`
+    while a `PrefillWorker` absorbs a long-prompt burst over
+    `LocalTransport`. The decode stream's per-step cost and its
+    tokens-per-scheduler-step are measured in a pre-burst baseline
+    window and again with the burst in flight; the perturbation ratio
+    must stay within +/-10% (asserted IN-LEG — a regression fails the
+    bench, not just a dashboard). The same schedule replayed on a
+    monolithic chunked `PagedEngine` records the counterfactual: its
+    interleaving scheduler gives whole steps to the burst's chunks, so
+    the steady stream's tokens/step collapses — the interference the
+    split removes. Hand-off latency p50/p99 and shipped bytes come from
+    the decode worker's registry.
+
+    Leg 2 (router): a mixed llama+gpt+bert arrival trace with three
+    tenants and both SLO classes through one `Router`; per-model and
+    per-tenant counters land in the record AND the router registry is
+    exported whole as the --telemetry-out artifact."""
+    import signal
+
+    def _stuck(signum, frame):
+        print("BENCH_DISAGG_TIMEOUT", flush=True)
+        os._exit(3)
+
+    signal.signal(signal.SIGALRM, _stuck)
+    signal.alarm(1400)
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import llama_functional as lf
+    from paddle_tpu.serving import PagedEngine, Request
+    from paddle_tpu.serving.disagg import (DecodeWorker, LocalTransport,
+                                           PrefillWorker)
+
+    backend = jax.default_backend()
+    if backend == "tpu":
+        from paddle_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=2048,
+                          intermediate_size=5504, num_hidden_layers=16,
+                          num_attention_heads=16,
+                          max_position_embeddings=2048)
+        args = lf.LlamaArgs.from_config(cfg)
+        params = lf.init_params(args, jax.random.key(0), jnp.bfloat16)
+        kw = dict(max_slots=8, max_len=2048, page_size=64, min_bucket=64)
+        chunk, steady_len, steady_new = 256, 128, 256
+        burst_len, burst_new, win = 1536, 16, 20
+    else:
+        args = lf.LlamaArgs(vocab_size=512, hidden_size=128,
+                            intermediate_size=352, num_layers=2,
+                            num_heads=4, num_kv_heads=2, rope_theta=1e4,
+                            rms_eps=1e-6, use_flash=False)
+        params = lf.init_params(args, jax.random.key(0))
+        kw = dict(max_slots=4, max_len=256, page_size=16, min_bucket=16)
+        chunk, steady_len, steady_new = 64, 24, 120
+        burst_len, burst_new, win = 160, 8, 20
+
+    rng = np.random.default_rng(seed)
+
+    def prompt(n):
+        return rng.integers(1, args.vocab_size, n).astype(np.int32)
+
+    steady_prompt = prompt(steady_len)
+    burst_prompts = [prompt(burst_len) for _ in range(4)]
+
+    lt = LocalTransport()
+    pw = PrefillWorker(params, args, transport=lt, prefill_chunk=chunk,
+                       **kw)
+    done = {}
+    dw = DecodeWorker(params, args, transport=lt,
+                      completion_cb=lambda r: done.setdefault(
+                          r.request_id, len(r.token_ids)), **kw)
+
+    def pw_drain():
+        while pw.queue or pw.slots.active_slots or pw._chunk_streams:
+            pw.step()
+
+    # warm every program (chunked long-prefill buckets, hand-off
+    # extract/scatter, the decode step) so the windows time execution
+    pw.submit(Request(prompt(burst_len), 4, request_id="warm"))
+    pw_drain()
+    while "warm" not in done:
+        dw.step()
+
+    pw.submit(Request(steady_prompt, steady_new, request_id="steady"))
+    pw_drain()
+    while not dw.slots.active_slots:
+        dw.step()
+    for _ in range(6):
+        dw.step()
+
+    def steady_tokens():
+        for s in dw.slots.active_slots:
+            r = dw.slots.owner(s)
+            if r.request_id == "steady":
+                return len(r.token_ids)
+        raise AssertionError("steady stream not seated")
+
+    def window(k, burst_active=False):
+        """k decode-worker steps; the prefill worker's burst (when
+        active) advances between them, exactly as the two engines
+        interleave on one host. Returns (steady tokens/step, min
+        decode-step seconds — min because shared-host scheduler noise
+        swings the median +/-50% run to run, while a real interference
+        regression raises the floor)."""
+        n0, times = steady_tokens(), []
+        for _ in range(k):
+            if burst_active and (pw.queue or pw.slots.active_slots
+                                 or pw._chunk_streams):
+                pw.step()
+            t0 = time.perf_counter()
+            dw.step()
+            times.append(time.perf_counter() - t0)
+        return (steady_tokens() - n0) / k, min(times)
+
+    base_rate, base_ms = window(win)
+    for i, p in enumerate(burst_prompts):
+        pw.submit(Request(p, burst_new, request_id=f"burst{i}"))
+    burst_rate, burst_ms = window(win, burst_active=True)
+    pw_drain()
+    t0, n0 = time.perf_counter(), sum(done.values())
+    while len(done) < 6:
+        dw.step()
+    decode_tps = (sum(done.values()) - n0) / (time.perf_counter() - t0)
+
+    rate_ratio = burst_rate / base_rate
+    step_ratio = burst_ms / base_ms
+    # the disaggregation bar, asserted in-leg: the steady stream keeps
+    # its one-token-per-scheduler-step rate while the burst prefills.
+    # (The wall-clock floor ratio is recorded, not asserted: on a
+    # shared-host CPU rig the floor still carries cross-engine cache
+    # noise; the monolithic counterfactual below shows what an actual
+    # scheduler-level perturbation looks like.)
+    assert 0.9 <= rate_ratio <= 1.1, (
+        f"steady decode rate perturbed by burst: {rate_ratio:.3f}")
+
+    reg = dw.metrics.registry
+    disagg = {
+        "handoffs": int(dw.metrics.counter("handoffs_admitted")),
+        "handoff_mb": round(pw.metrics.counter("handoff_bytes") / 1e6, 3),
+        "handoff_latency_s_p50": round(
+            reg.quantile("handoff_latency_s", 0.5), 4),
+        "handoff_latency_s_p99": round(
+            reg.quantile("handoff_latency_s", 0.99), 4),
+        "decode_step_ms_base": round(base_ms * 1e3, 3),
+        "decode_step_ms_burst": round(burst_ms * 1e3, 3),
+        "decode_step_perturbation": round(step_ratio, 3),
+        "steady_tokens_per_step_base": round(base_rate, 3),
+        "steady_tokens_per_step_burst": round(burst_rate, 3),
+        "decode_tokens_per_sec": round(decode_tps, 1),
+    }
+
+    # monolithic counterfactual: same schedule, one engine — the
+    # interleaved chunk prefills take the steady stream's steps
+    mono = PagedEngine(params, args, prefill_chunk=chunk, **kw)
+    s = mono.submit(Request(steady_prompt, steady_new,
+                            request_id="steady"))
+    while not mono.slots.active_slots:
+        mono.step()
+    for _ in range(6):
+        mono.step()
+    for i, p in enumerate(burst_prompts):
+        mono.submit(Request(p, burst_new, request_id=f"burst{i}"))
+    n0 = len(s.token_ids)
+    for _ in range(win):
+        mono.step()
+    disagg["monolithic_steady_tokens_per_step"] = round(
+        (len(s.token_ids) - n0) / win, 3)
+
+    out = {"backend": backend, "disagg": disagg,
+           "router": _bench_router_trace(params, args, seed)}
+    print("BENCH_DISAGG " + json.dumps(out))
+    return out
+
+
+def _bench_router_trace(params, args, seed):
+    """Mixed llama+gpt+bert trace through one Router: three tenants,
+    both SLO classes, per-model/per-tenant counters. The router registry
+    is left on `_bench_serving_disagg.last_registry` so subcommand runs
+    export it as the --telemetry-out artifact."""
+    from paddle_tpu.models.bert import bert_tiny
+    from paddle_tpu.models.generation import (GPTGenArgs,
+                                              gpt_params_from_layer)
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving import PagedEngine
+    from paddle_tpu.serving.router import BertBackend, GptEngine, Router
+
+    gcfg = GPTConfig(vocab_size=96, hidden_size=48, intermediate_size=96,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     max_position_embeddings=64)
+    gparams = gpt_params_from_layer(GPTForCausalLM(gcfg))
+    gargs = GPTGenArgs.from_config(gcfg)
+
+    router = Router({
+        "llama": PagedEngine(params, args, max_slots=4, max_len=128,
+                             page_size=16, min_bucket=16),
+        "gpt": GptEngine(gparams, gargs, max_slots=2, max_len=64,
+                         min_bucket=8),
+        "bert": BertBackend(bert_tiny(), max_batch=4),
+    })
+    rng = np.random.default_rng(seed + 1)
+    tenants = ("acme", "globex", "initech")
+    trace = []
+    for i in range(6):
+        trace.append({
+            "model": "llama", "arrival_step": i,
+            "prompt": rng.integers(1, args.vocab_size, 12 + i).astype(
+                np.int32),
+            "max_new_tokens": 8, "tenant": tenants[i % 3],
+            "slo": "interactive" if i % 2 == 0 else "batch"})
+    for i in range(4):
+        trace.append({
+            "model": "gpt", "arrival_step": 2 * i + 1,
+            "prompt": rng.integers(1, 96, 9 + i).astype(np.int32),
+            "max_new_tokens": 6, "tenant": tenants[i % 3],
+            "slo": "interactive"})
+    for i in range(4):
+        trace.append({
+            "model": "bert", "arrival_step": 3 * i,
+            "prompt": rng.integers(1, 1024, 10 + i).astype(np.int32),
+            "tenant": tenants[(i + 1) % 3], "slo": "batch"})
+
+    t0 = time.perf_counter()
+    reqs = router.replay(trace)
+    dt = time.perf_counter() - t0
+    assert all(r.finished for r in reqs)
+
+    reg = router.metrics.registry
+    snap = reg.snapshot()
+
+    def series(name, key):
+        out = {}
+        for labels, v in snap["counters"].get(name, {}).items():
+            part = dict(kv.split("=") for kv in labels.split(","))
+            out[part[key]] = out.get(part[key], 0) + v
+        return out
+
+    _bench_serving_disagg.last_registry = reg
+    return {
+        "requests": len(trace),
+        "wall_s": round(dt, 3),
+        "tokens_per_sec": round(
+            sum(len(r.token_ids) for r in reqs) / dt, 1),
+        "completed_by_model": series("router_completed", "model"),
+        "completed_by_tenant": series("router_completed", "tenant"),
+        "tokens_by_model": series("router_tokens", "model"),
+        "tokens_by_tenant": series("router_tokens", "tenant"),
+    }
+
+
 def _bench_resnet_fit(batch=64, size=224, iters=24, warmup_iters=4):
     """Config 2 (BASELINE): ResNet-50 through `paddle.Model.fit` — the
     hapi high-level loop (reference model.py:1472), synthetic ImageNet-shaped
@@ -1477,6 +1730,27 @@ def main(telemetry_out=None):
     except subprocess.TimeoutExpired:
         print("serving-capacity bench timed out", file=sys.stderr)
 
+    # disaggregated prefill/decode + SLO router legs (ISSUE 20): every
+    # backend — the in-leg +/-10% perturbation assertion makes a disagg
+    # regression fail the bench rather than drift in a dashboard
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--serving-disagg"]
+            + _tele_args("serving_disagg"),
+            capture_output=True, text=True, timeout=1500,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in out.stdout.splitlines():
+            if line.startswith("BENCH_DISAGG "):
+                record["serving_disagg"] = json.loads(
+                    line[len("BENCH_DISAGG "):])
+                _collect_leg("serving_disagg")
+                break
+        else:
+            print(f"serving-disagg bench failed:\n{out.stderr[-2000:]}",
+                  file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print("serving-disagg bench timed out", file=sys.stderr)
+
     if telemetry_out:
         write_telemetry(telemetry_out, record, legs=leg_metrics)
         if tele_dir is not None:
@@ -1554,6 +1828,8 @@ if __name__ == "__main__":
         _rec = _bench_serving()
     elif _argv == ["--serving-capacity"]:
         _rec = _bench_serving_capacity()
+    elif _argv == ["--serving-disagg"]:
+        _rec = _bench_serving_disagg()
     elif _argv == ["--baseline-resnet"]:
         _rec = _bench_resnet_fit()
     elif _argv == ["--baseline-bert"]:
@@ -1567,6 +1843,7 @@ if __name__ == "__main__":
     else:
         sys.exit(main(telemetry_out=_tele))
     if _tele:  # subcommand modes write the same artifact shape as main()
-        write_telemetry(_tele, _rec,
-                        registry=getattr(_bench_serving, "last_registry",
-                                         None))
+        write_telemetry(
+            _tele, _rec,
+            registry=(getattr(_bench_serving_disagg, "last_registry", None)
+                      or getattr(_bench_serving, "last_registry", None)))

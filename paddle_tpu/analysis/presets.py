@@ -1,9 +1,10 @@
 """The wired-up CPU audit: every rule over every real program family.
 
 `run_cpu_audits()` is the single entry point tier-1 and tools/lint.py
-share. It builds the four program families at toy size (fused-CE
+share. It builds the five program families at toy size (fused-CE
 fwd+bwd, the hybrid engine's train step, the fused optimizer
-write-back, the PagedEngine's captured serving steps) and applies the
+write-back, the PagedEngine's captured serving steps, and the
+disaggregated-serving migration + router-GPT programs) and applies the
 rule suite with the repo's pinned invariants:
 
   - no [batch, seq, vocab] intermediate anywhere near the loss;
@@ -29,7 +30,8 @@ from paddle_tpu.analysis import (buffer_audit, collective_audit,
                                  donation_audit, dtype_audit,
                                  host_sync_audit, programs)
 
-__all__ = ["GOLDEN_COLLECTIVES", "BYTE_CEILINGS", "run_cpu_audits"]
+__all__ = ["GOLDEN_COLLECTIVES", "GOLDEN_DISAGG", "BYTE_CEILINGS",
+           "run_cpu_audits"]
 
 # static collective structure of each serving program: the layer stack
 # is a scan, so the census counts the body once — 2 row-parallel psum
@@ -51,6 +53,21 @@ GOLDEN_COLLECTIVES = {
     "page_copy_int8": (0, _EMPTY_FP),
 }
 
+# the disaggregated-serving + router family is its OWN golden dict: the
+# serving captures above must not silently grow entries when disagg
+# programs change (and vice versa). The migration pair is pure data
+# movement — a collective creeping into extract/scatter would put a
+# cross-shard hop on every hand-off; the GPT stripe programs are
+# single-chip (the router's second model family has no TP mesh).
+GOLDEN_DISAGG = {
+    "page_extract": (0, _EMPTY_FP),
+    "page_scatter": (0, _EMPTY_FP),
+    "page_extract_int8": (0, _EMPTY_FP),
+    "page_scatter_int8": (0, _EMPTY_FP),
+    "gpt_prefill": (0, _EMPTY_FP),
+    "gpt_decode": (0, _EMPTY_FP),
+}
+
 # largest-intermediate ceilings at the toy geometry (measured max plus
 # ~40% headroom): a blowup past these means a buffer class that did not
 # exist when the budget was pinned
@@ -68,6 +85,17 @@ BYTE_CEILINGS = {
     "paged_prefill_int8": 26 * 1024,
     "paged_decode_int8": 26 * 1024,
     "page_copy_int8": 26 * 1024,
+    # disagg migration: extract gathers ONE request's pages (measured 4K
+    # model-dtype / 1K int8 codes at toy size); scatter's largest buffer
+    # is the destination pool leaf it writes through (18K / 4.5K). The
+    # GPT stripe programs top out at the [slots, heads, len, hd] KV
+    # stripe (16K).
+    "page_extract": 6 * 1024,
+    "page_extract_int8": 2 * 1024,
+    "page_scatter": 26 * 1024,
+    "page_scatter_int8": 7 * 1024,
+    "gpt_prefill": 23 * 1024,
+    "gpt_decode": 23 * 1024,
 }
 
 _TRAIN_ARG_NAMES = ("params", "opt_state", "ids", "labels")
@@ -139,8 +167,30 @@ def audit_serving(tp=2):
     return out
 
 
+def audit_disagg():
+    """The disaggregated-serving family: KV-page migration programs
+    (model-dtype + int8 pools) and the router's GPT stripe programs —
+    census pinned by GOLDEN_DISAGG, scatter/stripe donation aliased,
+    host-sync ban + byte ceilings throughout."""
+    progs = programs.disagg_programs()
+    out = []
+    from paddle_tpu.analysis.base import Violation
+    for name in sorted(set(GOLDEN_DISAGG) - set(progs)):
+        out.append(Violation(
+            rule="audit.program-not-captured", program=name,
+            message="disagg program was never dispatched/captured — "
+                    "scheduler or capture-harness change?"))
+    for name, p in sorted(progs.items()):
+        count, fp = GOLDEN_DISAGG.get(name, (None, None))
+        out += collective_audit.check_collectives(
+            p.jaxpr, name, expect_count=count, expect_fingerprint=fp)
+        _donation(p, out)
+        _common(p, out)
+    return out
+
+
 def run_cpu_audits(families=("fused_ce", "train_step", "opt_writeback",
-                             "serving")):
+                             "serving", "disagg")):
     """Run every audit family; returns the full list of Violations
     (empty = the repo's compiled programs uphold every invariant)."""
     runners = {
@@ -148,6 +198,7 @@ def run_cpu_audits(families=("fused_ce", "train_step", "opt_writeback",
         "train_step": audit_train_step,
         "opt_writeback": audit_opt_writeback,
         "serving": audit_serving,
+        "disagg": audit_disagg,
     }
     out = []
     for fam in families:

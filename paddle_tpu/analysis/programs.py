@@ -29,7 +29,7 @@ import numpy as np
 
 __all__ = ["AuditProgram", "TOY", "toy_args", "fused_ce_programs",
            "train_step_program", "opt_writeback_program",
-           "serving_programs"]
+           "serving_programs", "disagg_programs"]
 
 # one toy geometry for every family: 2 layers, divisible by a degree-2
 # TP mesh (heads, kv heads, intermediate), tiny enough that every build
@@ -101,21 +101,25 @@ def _from_traced(name, traced, example_args, donated, meta=None):
 
 class _Recorder:
     """Wrap a jitted callable; record the first call's args as
-    ShapeDtypeStructs so the exact program can be re-traced for audit."""
+    ShapeDtypeStructs so the exact program can be re-traced for audit.
+    Keyword args (the engines only pass static ones, e.g. the GPT
+    programs' `sample=`) are kept verbatim and replayed at trace time."""
 
     def __init__(self, jitted):
         self.jitted = jitted
         self.args = None
+        self.kwargs = {}
 
     def __call__(self, *a, **k):
-        if self.args is None and not k:
+        if self.args is None:
             self.args = tuple(_sds_tree(x) for x in a)
+            self.kwargs = dict(k)
         return self.jitted(*a, **k)
 
     def trace(self):
         if self.args is None:
             return None
-        return self.jitted.trace(*self.args)
+        return self.jitted.trace(*self.args, **self.kwargs)
 
 
 @functools.lru_cache(maxsize=None)
@@ -314,3 +318,103 @@ def serving_programs(tp=2, num_heads=None):
         out[name] = _from_traced(name, traced, rec.args,
                                  donated=donated[name], meta=meta)
     return out
+
+
+@functools.lru_cache(maxsize=None)
+def disagg_programs():
+    """Capture the disaggregated-serving + router device programs by
+    migrating tiny requests end-to-end (prefill worker -> LocalTransport
+    -> decode worker, model-dtype AND int8 pools) and serving a couple
+    of GPT requests through the router's `GptEngine`:
+
+      page_extract[/._int8]   the prefill side's pool gather (never
+                              donates — the pool must survive the ship)
+      page_scatter[/_int8]    the decode side's write of shipped page
+                              contents into fresh pages (donates both
+                              pool trees, like every other step program)
+      gpt_prefill/gpt_decode  the second autoregressive model family on
+                              the stripe scheduler (learned positions,
+                              donated KV stripes)
+
+    All six are single-chip programs; the migration pair is pinned
+    collective-free (pure data movement) — on a TP mesh the pool leaves
+    are sharded on the kv-head axis and extract/scatter still never
+    cross shards. Returns {name: AuditProgram}."""
+    from paddle_tpu.serving import PagedEngine, Request  # noqa: F401
+    from paddle_tpu.serving.disagg import (DecodeWorker, LocalTransport,
+                                           PrefillWorker)
+    from paddle_tpu.serving.router import GptEngine
+    from paddle_tpu.models import llama_functional as lf
+
+    args = toy_args()
+    params = lf.init_params(args, jax.random.key(0))
+    kw = dict(max_slots=2, max_len=32, page_size=8, min_bucket=8,
+              donate_steps=True)
+    rng = np.random.default_rng(11)
+
+    def prompt(n, vocab=args.vocab_size):
+        return rng.integers(1, vocab, size=n).astype(np.int32)
+
+    recs, donated = {}, {}
+    meta = {"tp": 0, "num_layers": args.num_layers}
+
+    def migrate(kv_dtype, suffix):
+        lt = LocalTransport()
+        pw = PrefillWorker(params, args, transport=lt,
+                           kv_dtype=kv_dtype, **kw)
+        done = []
+        dw = DecodeWorker(params, args, transport=lt, kv_dtype=kv_dtype,
+                          completion_cb=done.append, **kw)
+        recs[f"page_extract{suffix}"] = pw._page_extract = _Recorder(
+            pw._page_extract)
+        recs[f"page_scatter{suffix}"] = dw._page_scatter = _Recorder(
+            dw._page_scatter)
+        donated[f"page_extract{suffix}"] = ()
+        donated[f"page_scatter{suffix}"] = (0, 1)
+        pw.submit(Request(prompt(12), max_new_tokens=3))
+        for _ in range(64):
+            if not (pw.queue or pw.slots.active_slots or pw._chunk_streams):
+                break
+            pw.step()
+        for _ in range(64):
+            if done:
+                break
+            dw.step()
+        assert done, "migration never completed — capture harness broken"
+
+    migrate(None, "")
+    migrate("int8", "_int8")
+
+    gpt = GptEngine(*_gpt_toy(), max_slots=2, max_len=32, min_bucket=8,
+                    donate_steps=True)
+    recs["gpt_prefill"] = gpt._prefill = _Recorder(gpt._prefill)
+    recs["gpt_decode"] = gpt._decode = _Recorder(gpt._decode)
+    donated["gpt_prefill"] = (3, 4)
+    donated["gpt_decode"] = (2, 3)
+    gpt.serve([Request(prompt(10, 64), max_new_tokens=3),
+               Request(prompt(6, 64), max_new_tokens=2)])
+
+    out = {}
+    for name, rec in recs.items():
+        traced = rec.trace()
+        if traced is None:
+            continue  # program never dispatched (scheduler change?)
+        out[name] = _from_traced(name, traced, rec.args,
+                                 donated=donated[name], meta=meta)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _gpt_toy():
+    """Toy GPT-2 params/args for the router's second autoregressive
+    family — same scale discipline as TOY (2 layers, degree-2-divisible
+    heads, position table bounding max_len=32)."""
+    from paddle_tpu.models.generation import (GPTGenArgs,
+                                              gpt_params_from_layer)
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, intermediate_size=48,
+                    num_hidden_layers=2, num_attention_heads=2,
+                    max_position_embeddings=32)
+    return gpt_params_from_layer(GPTForCausalLM(cfg)), \
+        GPTGenArgs.from_config(cfg)

@@ -69,4 +69,7 @@ from paddle_tpu.distributed.api_extras import *  # noqa: F401,F403,E402
 from paddle_tpu.distributed.checkpoint import (  # noqa: F401,E402
     CheckpointManager, load_state_dict, save_state_dict,
 )
+from paddle_tpu.distributed.nonfinite_guard import (  # noqa: F401,E402
+    NonFiniteError, NonFiniteGuard,
+)
 from paddle_tpu.distributed import io  # noqa: F401,E402

@@ -1,0 +1,114 @@
+"""Skip-don't-die guard for non-finite training steps.
+
+Large-batch mixed-precision runs occasionally produce a NaN/inf loss or
+gradient (an attention overflow, a pathological batch) long before the
+run is actually diverging. Crashing the job — or worse, silently folding
+the NaN into the optimizer state, which poisons EVERY later step —
+is the wrong default for multi-day training. The guard implements the
+standard production policy instead:
+
+  * traced (`guard_update`): the step's output params/optimizer state
+    are selected between the freshly-updated values and the UNTOUCHED
+    inputs on an all-finite check over the loss and every gradient
+    leaf. A bad step is an exact identity update — params, Adam
+    moments, and Adam's step count all keep their pre-step values — at
+    the cost of two `lax.select`s per leaf, no host sync.
+
+  * host (`NonFiniteGuard.record`): counts skips. Isolated skips are
+    logged and forgiven; `max_consecutive` skips in a row mean the run
+    IS diverging and no amount of skipping will save it, so the guard
+    escalates by raising `NonFiniteError` — after the engine has
+    committed the (unchanged) state, so a supervisor catching the error
+    can checkpoint and rewind the data stream.
+
+The LR schedule is advanced by the engine only when `record` reports a
+clean step: a skipped step advances nothing. The returned loss is NOT
+rewritten — callers see the honest NaN/inf for their own logging.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["NonFiniteError", "NonFiniteGuard", "as_guard", "guard_update"]
+
+
+class NonFiniteError(RuntimeError):
+    """Raised by `NonFiniteGuard.record` after `max_consecutive`
+    guard-skipped steps in a row: the run is diverging, not hiccuping.
+    Engine state is committed (unchanged by the skipped steps) before
+    the raise, so handlers can checkpoint/rewind safely."""
+
+
+class NonFiniteGuard:
+    """Host-side skip policy + counters for guarded training steps."""
+
+    def __init__(self, max_consecutive=3):
+        if max_consecutive < 1:
+            raise ValueError("max_consecutive must be >= 1")
+        self.max_consecutive = int(max_consecutive)
+        self.skipped_total = 0
+        self.consecutive = 0
+        self.steps = 0
+
+    def record(self, skipped):
+        """Fold one step's device-computed skip flag into the policy.
+        Returns the flag (True = the step was an identity update);
+        raises `NonFiniteError` when the consecutive-skip budget is
+        exhausted."""
+        self.steps += 1
+        if not skipped:
+            self.consecutive = 0
+            return False
+        self.skipped_total += 1
+        self.consecutive += 1
+        if self.consecutive >= self.max_consecutive:
+            raise NonFiniteError(
+                f"{self.consecutive} consecutive non-finite training "
+                f"steps (guard budget max_consecutive="
+                f"{self.max_consecutive}, {self.skipped_total} skipped "
+                f"of {self.steps} total): the run is diverging — "
+                "lower the LR / rewind to a checkpoint")
+        return True
+
+
+def as_guard(spec):
+    """Coerce a constructor argument into a guard: None stays None
+    (unguarded — zero overhead), True builds a default `NonFiniteGuard`,
+    an int builds one with that consecutive-skip budget, and a ready
+    `NonFiniteGuard` passes through."""
+    if spec is None or isinstance(spec, NonFiniteGuard):
+        return spec
+    if spec is True:
+        return NonFiniteGuard()
+    if isinstance(spec, int) and not isinstance(spec, bool):
+        return NonFiniteGuard(max_consecutive=spec)
+    raise TypeError(
+        "nonfinite_guard must be None, True, an int budget, or a "
+        f"NonFiniteGuard, got {spec!r}")
+
+
+def _all_finite(*trees):
+    """Traced scalar bool: every floating leaf of every tree is finite.
+    Non-float leaves (step counters and the like) are vacuously fine."""
+    ok = jnp.asarray(True)
+    for tree in trees:
+        for leaf in jax.tree_util.tree_leaves(tree):
+            leaf = jnp.asarray(leaf)
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(leaf)))
+    return ok
+
+
+def guard_update(loss, grads, new_params, new_opt, params, opt_state):
+    """Traced tail of a guarded train step: select (new_params, new_opt)
+    when loss and grads are all-finite, the untouched (params, opt_state)
+    inputs otherwise. Returns (params, opt_state, skipped) — `skipped`
+    is the device bool the host feeds to `NonFiniteGuard.record`."""
+    finite = _all_finite(loss, grads)
+    pick = lambda new, old: jax.lax.select(  # noqa: E731 — leaf-wise pair
+        finite, jnp.asarray(new), jnp.asarray(old))
+    out_params = jax.tree_util.tree_map(pick, new_params, params)
+    out_opt = jax.tree_util.tree_map(pick, new_opt, opt_state)
+    return out_params, out_opt, jnp.logical_not(finite)

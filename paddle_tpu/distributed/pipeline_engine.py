@@ -142,7 +142,8 @@ class PipelineEngine:
 
     def __init__(self, model, loss=None, optimizer=None, dp=1, pp=None, mp=1,
                  micro_batches=None, mp_spec_fn=None, sharding_stage=1,
-                 devices=None, remat=True, seed=0, lr=None):
+                 devices=None, remat=True, seed=0, lr=None,
+                 nonfinite_guard=None):
         from paddle_tpu.distributed.fleet.meta_parallel.pp_layers import (
             PipelineLayer, SharedLayerDesc)
 
@@ -169,6 +170,10 @@ class PipelineEngine:
         self.remat = remat
         self._lr = lr
         self._key = jax.random.key(seed)
+        # skip-don't-die on NaN/inf grads (see nonfinite_guard.py)
+        from paddle_tpu.distributed.nonfinite_guard import as_guard
+
+        self.nonfinite_guard = as_guard(nonfinite_guard)
 
         fns = []
         for i, layer in enumerate(layers):
@@ -515,6 +520,8 @@ class PipelineEngine:
         opt_update, slots = self._opt_update, self._slots
         grad_clip = self._grad_clip
 
+        guarded = self.nonfinite_guard is not None
+
         def train_step(params, opt_state, key, lr, inputs, labels):
             from paddle_tpu.distributed.engine import apply_optimizer_updates
 
@@ -525,11 +532,21 @@ class PipelineEngine:
             new_params, new_opt = apply_optimizer_updates(
                 params, grads, opt_state, opt_update, slots, lr,
                 self._decay_mask)
-            return loss, new_params, new_opt
+            if not guarded:
+                return loss, new_params, new_opt
+            # NonFiniteGuard: identity update on NaN/inf + a skipped flag
+            # for the host-side counter (see nonfinite_guard.guard_update)
+            from paddle_tpu.distributed.nonfinite_guard import guard_update
 
+            return (loss,) + guard_update(loss, grads, new_params, new_opt,
+                                          params, opt_state)
+
+        out_shardings = (None, self._pshard, self._oshard)
+        if guarded:
+            out_shardings = out_shardings + (None,)
         self._train_step = jax.jit(
             train_step, donate_argnums=(0, 1),
-            out_shardings=(None, self._pshard, self._oshard))
+            out_shardings=out_shardings)
         return self._train_step
 
     def _place_batch(self, arrays):
@@ -556,13 +573,27 @@ class PipelineEngine:
         lr = jnp.asarray(
             self._lr if self._lr is not None else self.optimizer.get_lr(),
             jnp.float32)
-        loss, params, opt_state = step(
+        out = step(
             params, opt_state, sub, lr,
             self._place_batch(inputs), self._place_batch(labels))
+        skipped = None
+        if self.nonfinite_guard is not None:
+            loss, params, opt_state, skipped = out
+        else:
+            loss, params, opt_state = out
+        # commit the FRESH outputs before record() may escalate: the old
+        # self._state arrays were donated to the step, and a caller
+        # catching NonFiniteError must find live state
         self._state = [params, opt_state]
-        if (self._lr is None
+        was_skipped = False
+        if skipped is not None:
+            was_skipped = self.nonfinite_guard.record(bool(skipped))
+        if (not was_skipped
+                and self._lr is None
                 and hasattr(self.optimizer, "_learning_rate")
                 and hasattr(self.optimizer._learning_rate, "step")):
+            # a guard-skipped step advances NOTHING — not params, not
+            # Adam's step count, and not the LR schedule
             self.optimizer._learning_rate.step()
         return loss
 

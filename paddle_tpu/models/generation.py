@@ -547,24 +547,15 @@ def _row_keys(seeds, pos):
                                (base.shape[0],)))
 
 
-def _sample(logits, sample, temperature, top_p, key, top_k=0,
-            row_keys=None):
-    """The per-request sampler. `sample` is the only STATIC switch (argmax
-    vs categorical program structure); temperature/top_p/top_k are traced
-    scalars OR per-row [b] vectors, so serving can vary them per request —
-    per SLOT — without recompiling the decode program. Rows with
-    temperature <= 0 stay exactly greedy (argmax), which is what keeps a
-    greedy request's output bit-identical inside a mixed sampling batch.
-
-    top_k <= 0 disables the top-k mask (all of vocab survives); top_p and
-    top_k compose (k-mask first, nucleus over what remains — the
-    huggingface/vLLM order). Sampling draws from `key` (one shared PRNG
-    stream, split by the caller per step) or, when `row_keys` [b] is
-    given, per-row gumbel-max draws — the per-request-seed path, where a
-    request's randomness depends only on its own seed and position, not
-    on which other requests share its batch."""
-    if not sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+def _warp_logits(logits, temperature, top_p, top_k):
+    """The per-request logit warp shared by `_sample` and rejection-
+    sampling speculation: temperature scale, then top-k mask, then
+    nucleus mask over the k-survivors (-1e30 for killed entries).
+    Returns (masked [b, vocab], greedy_rows [b]). Rejection sampling
+    needs the warped DISTRIBUTION itself (softmax of `masked`), not just
+    a draw — and draft/target must warp with bit-identical math for the
+    acceptance ratio p_target/p_draft to mean anything, hence the single
+    shared implementation."""
     b, vocab = logits.shape
     t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
     greedy_rows = t <= 0.0
@@ -588,10 +579,33 @@ def _sample(logits, sample, temperature, top_p, key, top_k=0,
     cutoff_idx = jnp.sum(cum < p_vec, axis=-1, keepdims=True)
     cutoff = jnp.take_along_axis(sorted_masked, cutoff_idx, axis=-1)
     masked = jnp.where((scaled >= cutoff) & (scaled >= kth), scaled, -1e30)
+    return masked, greedy_rows
+
+
+def _sample(logits, sample, temperature, top_p, key, top_k=0,
+            row_keys=None):
+    """The per-request sampler. `sample` is the only STATIC switch (argmax
+    vs categorical program structure); temperature/top_p/top_k are traced
+    scalars OR per-row [b] vectors, so serving can vary them per request —
+    per SLOT — without recompiling the decode program. Rows with
+    temperature <= 0 stay exactly greedy (argmax), which is what keeps a
+    greedy request's output bit-identical inside a mixed sampling batch.
+
+    top_k <= 0 disables the top-k mask (all of vocab survives); top_p and
+    top_k compose (k-mask first, nucleus over what remains — the
+    huggingface/vLLM order). Sampling draws from `key` (one shared PRNG
+    stream, split by the caller per step) or, when `row_keys` [b] is
+    given, per-row gumbel-max draws — the per-request-seed path, where a
+    request's randomness depends only on its own seed and position, not
+    on which other requests share its batch."""
+    if not sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    masked, greedy_rows = _warp_logits(logits, temperature, top_p, top_k)
 
     if row_keys is not None:
         # gumbel-max: argmax(logits + g) ~ categorical(softmax(logits)),
         # one independent draw per row from that row's own key
+        vocab = logits.shape[-1]
         u = jax.vmap(lambda k_: jax.random.uniform(
             k_, (vocab,), jnp.float32, minval=1e-20, maxval=1.0))(row_keys)
         drawn = jnp.argmax(masked - jnp.log(-jnp.log(u)), axis=-1)
@@ -817,10 +831,18 @@ def _gpt_layer_step(lp, h, cache_k, cache_v, pos, args: GPTGenArgs):
     q = (hin @ lp["wq"] + lp["bq"]).reshape(b, s, nh, hd)
     k = (hin @ lp["wk"] + lp["bk"]).reshape(b, s, nh, hd)
     v = (hin @ lp["wv"] + lp["bv"]).reshape(b, s, nh, hd)
-    cache_k = jax.lax.dynamic_update_slice_in_dim(
-        cache_k, jnp.swapaxes(k, 1, 2), pos, axis=2)
-    cache_v = jax.lax.dynamic_update_slice_in_dim(
-        cache_v, jnp.swapaxes(v, 1, 2), pos, axis=2)
+    if jnp.ndim(pos) == 1:
+        # per-row positions (serving decode; s must be 1) — the same
+        # vmapped per-row cache write the llama `_layer_step` uses
+        write = jax.vmap(lambda c, new, p: jax.lax.dynamic_update_slice_in_dim(
+            c, new, p, axis=1))
+        cache_k = write(cache_k, jnp.swapaxes(k, 1, 2), pos)
+        cache_v = write(cache_v, jnp.swapaxes(v, 1, 2), pos)
+    else:
+        cache_k = jax.lax.dynamic_update_slice_in_dim(
+            cache_k, jnp.swapaxes(k, 1, 2), pos, axis=2)
+        cache_v = jax.lax.dynamic_update_slice_in_dim(
+            cache_v, jnp.swapaxes(v, 1, 2), pos, axis=2)
     attn = _cached_attention(q, cache_k, cache_v, pos).reshape(b, s, nh * hd)
     h = h + (attn @ lp["wo"] + lp["bo"])
 
@@ -831,11 +853,18 @@ def _gpt_layer_step(lp, h, cache_k, cache_v, pos, args: GPTGenArgs):
 
 
 def _gpt_forward_cached(params, ids, caches_k, caches_v, pos,
-                        args: GPTGenArgs):
+                        args: GPTGenArgs, last_idx=None):
+    """pos: scalar, or int32 [b] per-row positions (serving decode, s=1).
+    last_idx: optional per-row index of the last REAL token (serving
+    prefills pad to a length bucket) — None keeps the h[:, -1] gather."""
     b, s = ids.shape
-    positions = pos + jnp.arange(s, dtype=jnp.int32)
-    h = (jnp.take(params["word_emb"], ids, axis=0)
-         + jnp.take(params["pos_emb"], positions, axis=0)[None])
+    if jnp.ndim(pos) == 1:
+        positions = pos[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        pe = jnp.take(params["pos_emb"], positions, axis=0)
+    else:
+        positions = pos + jnp.arange(s, dtype=jnp.int32)
+        pe = jnp.take(params["pos_emb"], positions, axis=0)[None]
+    h = jnp.take(params["word_emb"], ids, axis=0) + pe
 
     def step(carry, lp_kv):
         h = carry
@@ -846,7 +875,13 @@ def _gpt_forward_cached(params, ids, caches_k, caches_v, pos,
     h, (new_k, new_v) = jax.lax.scan(step, h,
                                      (params["layers"], caches_k, caches_v))
     h = _layer_norm(h, params["lnf_w"], params["lnf_b"], args.ln_eps)
-    logits = h[:, -1, :] @ params["word_emb"].T  # tied head
+    if last_idx is None:
+        hl = h[:, -1, :]
+    else:
+        idx = jnp.broadcast_to(jnp.asarray(last_idx, jnp.int32).reshape(-1),
+                               (h.shape[0],))
+        hl = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0, :]
+    logits = hl @ params["word_emb"].T  # tied head
     return logits.astype(jnp.float32), new_k, new_v
 
 

@@ -40,11 +40,35 @@ all preserving exact greedy parity with sequential `generate`:
     bit-exact argmax in mixed batches; seeds make tokens
     batch-independent).
 
+Above the single-engine layer sit two ISSUE-20 subsystems:
+
+  - DISAGGREGATED PREFILL/DECODE (`serving/disagg.py`): `PrefillWorker`
+    and `DecodeWorker` are role-restricted `PagedEngine`s — prefill
+    never decodes, decode never admits locally. A finished prefill
+    becomes a `KVHandoff` (request identity + sampling state + the
+    slot's KV page contents, bf16 or int8 `QuantizedKVPage`s verbatim)
+    shipped over a transport (`LocalTransport` in-process,
+    `StoreTransport` over the TCPStore in the 2-process rig); the
+    decode side re-scatters the pages into fresh pool pages and seats
+    the request mid-flight — greedy output stays token-for-token equal
+    to a monolithic engine, and the steady decode stream keeps its
+    per-step rate while the other role absorbs long-prompt bursts.
+    `DisaggServer` wires one prefill + one decode worker behind a
+    single submit/step surface.
+  - SLO-AWARE MULTI-MODEL ROUTER (`serving/router.py`): a `Router`
+    fronts named backends — llama (`PagedEngine`), GPT-2 (`GptEngine`,
+    the stripe scheduler re-pointed at `_gpt_forward_cached`), BERT
+    embeddings (`BertBackend`, batched non-autoregressive forwards) —
+    with `slo="interactive"|"batch"` classes, preemption of batch
+    slots (block-table checkpoint, bit-identical `resume`), and
+    per-model/per-tenant labeled counters on its registry.
+
 `serving/scheduler.py` holds the admission queue / length buckets /
 slot table / page math; `serving/metrics.py` the counters (queue depth,
 TTFT, tokens/sec, occupancy, compile counts, prefix-cache hit rate,
-pages in use/free, COW copies, prefill chunks, draft proposed/accepted)
-that also back `inference.Config.enable_profile()`.
+pages in use/free, COW copies, prefill chunks, draft proposed/accepted,
+hand-off counts/bytes/latency, preemptions/resumes) that also back
+`inference.Config.enable_profile()`.
 
     from paddle_tpu.serving import PagedEngine, Request
 
@@ -64,9 +88,14 @@ monolithic TTFT leg, and a speculative-vs-greedy tokens/sec leg.
 
 from paddle_tpu.serving.block_manager import (NULL_PAGE, BlockAllocator,
                                               PrefixMatch)
+from paddle_tpu.serving.disagg import (DecodeWorker, DisaggServer,
+                                       KVHandoff, LocalTransport,
+                                       PrefillWorker, StoreTransport)
 from paddle_tpu.serving.engine import Engine, Request
 from paddle_tpu.serving.metrics import Metrics
 from paddle_tpu.serving.paged_engine import PagedEngine
+from paddle_tpu.serving.router import (BertBackend, EmbeddingRequest,
+                                       GptEngine, Router)
 from paddle_tpu.serving.sampler import SlotSampler
 from paddle_tpu.serving.scheduler import (AdmissionQueue, SlotTable,
                                           bucket_for, pages_for)
@@ -74,4 +103,7 @@ from paddle_tpu.serving.spec_decode import SpecDecoder
 
 __all__ = ["Engine", "PagedEngine", "Request", "Metrics", "BlockAllocator",
            "PrefixMatch", "NULL_PAGE", "AdmissionQueue", "SlotTable",
-           "SlotSampler", "SpecDecoder", "bucket_for", "pages_for"]
+           "SlotSampler", "SpecDecoder", "bucket_for", "pages_for",
+           "PrefillWorker", "DecodeWorker", "DisaggServer", "KVHandoff",
+           "LocalTransport", "StoreTransport", "Router", "GptEngine",
+           "BertBackend", "EmbeddingRequest"]

@@ -409,11 +409,6 @@ class PagedEngine(Engine):
     def submit(self, req):
         if not isinstance(req, Request):
             req = Request(req)
-        if self.spec_enabled and req.temperature > 0:
-            raise ValueError(
-                "speculative decoding serves greedy requests only "
-                "(exact-match acceptance); submit with temperature=0 or "
-                "build the engine without draft_params")
         need = pages_for(req.prompt_ids.size, req.max_new_tokens,
                          self.page_size)
         if need > self._alloc.capacity:
@@ -733,6 +728,57 @@ class PagedEngine(Engine):
         if self.spec_enabled:
             self._spec.retire(slot)
         super()._retire(slot)
+
+    # -- preemption ---------------------------------------------------------
+    def preempt(self, slot):
+        """Evict a DECODING request from its slot without losing work:
+        the returned state is the block table (page ids, refcounts still
+        held — the allocator cannot hand the pages out or evict them,
+        and prefix hits against the prompt's registered pages stay
+        COW-safe), the KV write position, and the last token. `resume`
+        re-seats it and the continuation is bit-identical to never
+        having been preempted: decode depends only on the held pages'
+        contents, the block table, `npos`, the last token, and the
+        (seed, pos) sampling stream — all preserved. The slot's
+        remaining page reservation is refunded while preempted, which is
+        the point: a waiting request can use it."""
+        req = self.slots.owner(slot)
+        if slot in self._chunk_streams:
+            raise ValueError(f"slot {slot} is mid-prefill-stream; only "
+                             "decoding slots are preemptible")
+        if self.spec_enabled:
+            raise ValueError("preemption with speculative decoding is "
+                             "unsupported (the draft's stripe cache is "
+                             "not checkpointed)")
+        state = {"req": req, "pages": self._bt[slot],
+                 "npos": int(self._npos[slot]),
+                 "last_tok": int(self._last_tok[slot]),
+                 "resv": self._resv.get(slot, 0)}
+        self._bt[slot] = []
+        self._reserved_total -= self._resv.pop(slot, 0)
+        self.slots.retire(slot)
+        self._npos[slot] = 0
+        self._last_tok[slot] = self.pad_id
+        self.sampler.clear(slot)
+        self.metrics.inc("preemptions")
+        return state
+
+    def can_resume(self, state):
+        return bool(self.slots.free_count) and \
+            state["resv"] <= self._alloc.available - self._reserved_total
+
+    def resume(self, state):
+        """Re-seat a preempted request (see `preempt`); returns its new
+        slot. Caller must have checked `can_resume`."""
+        req = state["req"]
+        slot = self._admit(req)
+        self._bt[slot] = state["pages"]
+        self._resv[slot] = state["resv"]
+        self._reserved_total += state["resv"]
+        self._npos[slot] = state["npos"]
+        self._last_tok[slot] = state["last_tok"]
+        self.metrics.inc("resumes")
+        return slot
 
     def reset(self):
         """Forget all requests, block tables, AND the prefix cache (cold

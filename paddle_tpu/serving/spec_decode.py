@@ -16,10 +16,18 @@ the whole round is two device dispatches regardless of the draft length:
   pos[r]+i, K/V scattered into the row's tail pages write-before-attend,
   writes past the row's page reservation redirected to the null page.
 
-  ACCEPT — the host commits the longest exactly-matching prefix plus the
-  target's own next token: between 1 and g+1 tokens per round, every one
-  of them exactly the target's greedy sequence (speculation changes the
-  schedule, never the output).
+  ACCEPT — greedy rows commit the longest exactly-matching prefix plus
+  the target's own next token: between 1 and g+1 tokens per round, every
+  one of them exactly the target's greedy sequence (speculation changes
+  the schedule, never the output). Sampling rows use Leviathan rejection
+  sampling instead: draft token i is accepted with probability
+  min(1, p_target(d)/p_draft(d)); the first rejection commits ONE token
+  resampled from the adjusted residual normalize(max(0, p_t - p_d)), a
+  fully accepted window commits a bonus token from the target's next
+  distribution through the sequential per-request (seed, pos) gumbel
+  stream. Every committed token is exactly target-distributed, and when
+  draft == target the ratio is 1 so the output is token-for-token the
+  sequential seeded sample (parity-tested).
 
   ROLL BACK — rejected tail tokens are erased by truncating the
   watermark (`_npos`) and the BLOCK TABLE: tail pages allocated for the
@@ -95,14 +103,46 @@ def _draft_window_traced(params, ids, h, ck, cv, slot, cos, sin, *, args,
     return ck, cv
 
 
+def _paged_verify_sampled_traced(params, ids, pk, pv, bt, pos, limit, cos,
+                                 sin, temp, top_p, top_k, *, args, metrics,
+                                 page_size, tp_axis=None, tp_degree=1):
+    """Verify variant for rejection-sampling rounds: same paged window
+    forward, but alongside the greedy argmax it returns the target's
+    WARPED distribution at every window position (softmax over the
+    shared `_warp_logits` masking) — the p_target the host acceptance
+    test and residual resample consume. Greedy rounds keep the slimmer
+    `_paged_verify_traced` program (and its captured golden)."""
+    metrics.inc("verify_compiles")
+    logits, pk, pv = gen._paged_forward_verify(
+        params, ids, pk, pv, bt, pos, limit, cos, sin, args, page_size,
+        tp_axis=tp_axis, tp_degree=tp_degree)
+    S, W, V = logits.shape
+    masked, _ = gen._warp_logits(logits.reshape(S * W, V),
+                                 jnp.repeat(temp, W), jnp.repeat(top_p, W),
+                                 jnp.repeat(top_k, W))
+    probs = jax.nn.softmax(masked, axis=-1).reshape(S, W, V)
+    return (pk, pv, jnp.argmax(logits, axis=-1).astype(jnp.int32), probs)
+
+
 def _draft_propose_traced(params, forced, n_forced, start, ck, cv, cos,
-                          sin, *, args, metrics, steps):
-    """Draft-model propose: `steps` greedy decode steps over the draft's
-    stripe cache in ONE traced scan (one device dispatch per round, not
-    per token). Step j of row r feeds forced[r, j] while j < n_forced[r]
-    — the committed tokens the draft hasn't ingested yet (its own last
+                          sin, temp, top_p, top_k, seeds, *, args, metrics,
+                          steps, sample=False):
+    """Draft-model propose: `steps` decode steps over the draft's stripe
+    cache in ONE traced scan (one device dispatch per round, not per
+    token). Step j of row r feeds forced[r, j] while j < n_forced[r] —
+    the committed tokens the draft hasn't ingested yet (its own last
     token, plus one catch-up token after a fully-accepted round) — and
-    its own previous output after that, at position start[r] + j."""
+    its own previous output after that, at position start[r] + j.
+
+    sample=False (greedy rounds) proposes by argmax. sample=True draws
+    step j's token from the draft's WARPED distribution via the
+    request's own (seed, position) gumbel stream — the `_row_keys`
+    stream sequential `generate(seeds=...)` uses, at the proposed
+    token's sequence index start + j + 1 — and additionally returns
+    those warped distributions [S, steps, vocab]: the p_draft of the
+    host's accept-with-prob-min(1, p_target/p_draft) test. Greedy rows
+    (temperature <= 0) inside a mixed batch still propose exact argmax
+    (`_sample`'s greedy_rows path)."""
     metrics.inc("draft_propose_compiles")
 
     def stepf(carry, xs):
@@ -111,13 +151,52 @@ def _draft_propose_traced(params, forced, n_forced, start, ck, cv, cos,
         tok = jnp.where(j < n_forced, forced_j, prev)
         logits, ck, cv = gen._forward_cached(
             params, tok[:, None], ck, cv, start + j, cos, sin, args)
-        out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return (out, ck, cv), out
+        if sample:
+            out = gen._sample(logits, True, temp, top_p, None, top_k,
+                              row_keys=gen._row_keys(seeds, start + j + 1))
+            masked, _ = gen._warp_logits(logits, temp, top_p, top_k)
+            probs = jax.nn.softmax(masked, axis=-1)
+        else:
+            out = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            probs = jnp.zeros((), jnp.float32)
+        return (out, ck, cv), (out, probs)
 
-    (_, ck, cv), outs = jax.lax.scan(
+    (_, ck, cv), (outs, probs) = jax.lax.scan(
         stepf, (forced[:, 0], ck, cv),
         (jnp.arange(steps, dtype=jnp.int32), jnp.swapaxes(forced, 0, 1)))
-    return ck, cv, jnp.swapaxes(outs, 0, 1)    # [S, steps]
+    outs = jnp.swapaxes(outs, 0, 1)            # [S, steps]
+    if sample:
+        return ck, cv, outs, jnp.swapaxes(probs, 0, 1)  # +[S, steps, V]
+    return ck, cv, outs
+
+
+_ACCEPT_SALT = 0xAC          # acceptance-test uniform branch
+_RESAMPLE_SALT = 0x5E        # residual-resample gumbel branch
+
+
+def _spec_key(seed, pos, salt):
+    """Host-side PRNG key for one (request, position) decision in a
+    rejection-sampling round: a salted branch of the request's
+    `_row_keys` (seed, position) stream — deterministic across
+    schedules (batch composition, chunking, preemption never change
+    it), and independent of the gumbel draws that CHOSE the draft
+    token (reusing those would correlate the accept test with the
+    proposal and bias the output distribution)."""
+    k = jax.random.fold_in(jax.random.key(0), seed)
+    k = jax.random.fold_in(k, pos)
+    return jax.random.fold_in(k, salt)
+
+
+def _residual_draw(residual, seed, pos):
+    """Sample from the normalized residual max(0, p_t - p_d)/Z via
+    gumbel-max on its log — the adjusted distribution that makes the
+    round's committed token EXACTLY target-distributed (Leviathan et
+    al. 2023, Thm. 1) regardless of draft quality."""
+    gumbel = np.asarray(jax.random.gumbel(
+        _spec_key(seed, pos, _RESAMPLE_SALT), residual.shape))
+    logp = np.where(residual > 0, np.log(np.maximum(residual, 1e-30)),
+                    -np.inf)
+    return int(np.argmax(logp + gumbel))
 
 
 class SpecDecoder:
@@ -166,17 +245,29 @@ class SpecDecoder:
         self._draft_propose = jax.jit(
             functools.partial(_draft_propose_traced, args=dargs,
                               metrics=engine.metrics, steps=self.g + 1),
-            donate_argnums=(4, 5) if donate else ())
+            donate_argnums=(4, 5) if donate else (),
+            static_argnames=("sample",))
         rep = P()
+        tp_kw = dict(
+            args=engine.args, metrics=engine.metrics,
+            page_size=engine.page_size,
+            tp_axis=engine.tp_axis if engine.mesh is not None else None,
+            tp_degree=engine.tp_degree)
         self._verify = engine._sharded(
-            functools.partial(
-                _paged_verify_traced, args=engine.args,
-                metrics=engine.metrics, page_size=engine.page_size,
-                tp_axis=engine.tp_axis if engine.mesh is not None else None,
-                tp_degree=engine.tp_degree),
+            functools.partial(_paged_verify_traced, **tp_kw),
             in_specs=(engine._pspecs, rep, engine._poolspec,
                       engine._poolspec, rep, rep, rep, rep, rep),
             out_specs=(engine._poolspec, engine._poolspec, rep),
+            donate=(2, 3) if donate else ())
+        # the rejection-sampling verify also returns the warped target
+        # distributions; built lazily-adjacent here so greedy-only
+        # engines never trace it
+        self._verify_sampled = engine._sharded(
+            functools.partial(_paged_verify_sampled_traced, **tp_kw),
+            in_specs=(engine._pspecs, rep, engine._poolspec,
+                      engine._poolspec, rep, rep, rep, rep, rep, rep,
+                      rep, rep),
+            out_specs=(engine._poolspec, engine._poolspec, rep, rep),
             donate=(2, 3) if donate else ())
 
     # -- lifecycle -----------------------------------------------------------
@@ -238,15 +329,22 @@ class SpecDecoder:
         req = self.eng.slots.owner(slot)
         return int(req.prompt_ids.size) + req.max_new_tokens - 2
 
-    def _propose_device(self, forced, n_forced, start):
+    def _propose_device(self, forced, n_forced, start, sample=False):
         """One draft-scan dispatch (separate method so tests can stub an
-        adversarial draft)."""
-        with self.eng.metrics.timer("draft_propose_s"):
-            self._dck, self._dcv, outs = self._draft_propose(
+        adversarial draft). Returns (outs, probs) — probs is None on
+        greedy rounds."""
+        eng = self.eng
+        with eng.metrics.timer("draft_propose_s"):
+            out = self._draft_propose(
                 self.draft_params, jnp.asarray(forced),
                 jnp.asarray(n_forced), jnp.asarray(start), self._dck,
-                self._dcv, self._dcos, self._dsin)
-        return np.asarray(outs)                           # [S, g]
+                self._dcv, self._dcos, self._dsin,
+                *eng.sampler.device_args(), sample=sample)
+            if sample:
+                self._dck, self._dcv, outs, probs = out
+                return np.asarray(outs), np.asarray(probs)
+            self._dck, self._dcv, outs = out
+        return np.asarray(outs), None                     # [S, steps]
 
     def step(self):
         """One speculation round: draft proposes g tokens (one traced
@@ -279,7 +377,9 @@ class SpecDecoder:
             for j in range(min(lag[slot] + 1, steps)):
                 forced[slot, j] = self._seq_token(
                     req, int(self._dpos[slot]) + j)
-        outs = self._propose_device(forced, n_forced, start)
+        sampling = eng._sampling_active()
+        outs, dprobs = self._propose_device(forced, n_forced, start,
+                                            sampling)
 
         # ---- tail pages for the verify window ----------------------------
         limit = np.full(S, -1, np.int32)
@@ -301,10 +401,18 @@ class SpecDecoder:
         for slot in active:
             bt[slot, :len(eng._bt[slot])] = eng._bt[slot]
         with eng.metrics.timer("verify_s"):
-            eng._pk, eng._pv, tgt = self._verify(
-                eng.params, jnp.asarray(ids), eng._pk, eng._pv,
-                jnp.asarray(bt), jnp.asarray(eng._npos),
-                jnp.asarray(limit), eng._cos, eng._sin)
+            if sampling:
+                eng._pk, eng._pv, tgt, tprobs = self._verify_sampled(
+                    eng.params, jnp.asarray(ids), eng._pk, eng._pv,
+                    jnp.asarray(bt), jnp.asarray(eng._npos),
+                    jnp.asarray(limit), eng._cos, eng._sin,
+                    *eng.sampler.device_args()[:3])
+                tprobs = np.asarray(tprobs)               # [S, g+1, V]
+            else:
+                eng._pk, eng._pv, tgt = self._verify(
+                    eng.params, jnp.asarray(ids), eng._pk, eng._pv,
+                    jnp.asarray(bt), jnp.asarray(eng._npos),
+                    jnp.asarray(limit), eng._cos, eng._sin)
             tgt = np.asarray(tgt)                         # [S, g+1]
 
         # ---- accept + roll back ------------------------------------------
@@ -313,11 +421,17 @@ class SpecDecoder:
             req = eng.slots.owner(slot)
             p = int(eng._npos[slot])
             drafts = [int(ids[slot, i]) for i in range(1, g + 1)]
-            a = 0
-            while a < g and drafts[a] == int(tgt[slot, a]):
-                a += 1
-            commit = drafts[:a] + [int(tgt[slot, a])] if a < g \
-                else drafts + [int(tgt[slot, g])]
+            if sampling and eng.sampler.any_sampling([slot]):
+                a, commit = self._accept_sampled(req, slot, p, drafts,
+                                                 lag[slot], dprobs, tprobs)
+            else:
+                # greedy rows keep EXACT-match acceptance (bit-identical
+                # to sequential argmax, even inside a sampling batch)
+                a = 0
+                while a < g and drafts[a] == int(tgt[slot, a]):
+                    a += 1
+                commit = drafts[:a] + [int(tgt[slot, a])] if a < g \
+                    else drafts + [int(tgt[slot, g])]
             k = 0
             for tok in commit:
                 eng._emit(req, tok)
@@ -342,6 +456,57 @@ class SpecDecoder:
         eng.metrics.observe("tokens_per_decode_step",
                             sum(len(v) for v in emitted.values()))
         return {"type": "spec_decode", "tokens": emitted}
+
+    def _accept_sampled(self, req, slot, p, drafts, lag, dprobs, tprobs):
+        """Rejection-sampling acceptance for one sampling row: draft
+        token i (proposed from warped p_draft) is accepted with
+        probability min(1, p_target/p_draft); the first rejection
+        commits one token resampled from the adjusted residual
+        normalize(max(0, p_target - p_draft)) and ends the round; a
+        fully-accepted window commits a bonus token drawn from the
+        target's own next distribution via the sequential (seed, pos)
+        gumbel stream. Every committed token is exactly
+        target-distributed — speculation changes the schedule, never
+        the law — and when draft == target the acceptance ratio is 1,
+        reducing the round to sequential seeded sampling (the parity
+        test)."""
+        g = self.g
+        commit = []
+        a = 0
+        for i in range(1, g + 1):
+            d = drafts[i - 1]
+            j = min(lag + i - 1, dprobs.shape[1] - 1)
+            pd = dprobs[slot, j]          # draft dist for index p+i
+            pt = tprobs[slot, i - 1]      # target dist for index p+i
+            ratio = float(pt[d]) / max(float(pd[d]), 1e-30)
+            u = float(jax.random.uniform(
+                _spec_key(req.seed, p + i, _ACCEPT_SALT), ()))
+            if u < min(1.0, ratio):
+                commit.append(d)
+                a += 1
+                continue
+            residual = np.maximum(pt.astype(np.float64)
+                                  - pd.astype(np.float64), 0.0)
+            tot = float(residual.sum())
+            if tot <= 0.0:
+                # degenerate (draft dominates everywhere — only possible
+                # through float rounding): fall back to the target dist
+                residual, tot = pt.astype(np.float64), float(pt.sum())
+            commit.append(_residual_draw(residual / tot, req.seed, p + i))
+            self.eng.metrics.inc("spec_resamples")
+            return a, commit
+        # all g drafts accepted: bonus token from the target's next
+        # distribution, drawn with the SAME gumbel-max + (seed, pos) key
+        # sequential `generate(seeds=...)` would use at index p+g+1 —
+        # log p_target is the warped logits up to a per-row constant, so
+        # the argmax (hence the token) is identical
+        pt = tprobs[slot, g]
+        keys = gen._row_keys(np.asarray([req.seed], np.int32), p + g + 1)
+        u = np.asarray(jax.vmap(lambda k_: jax.random.uniform(
+            k_, pt.shape, jnp.float32, minval=1e-20, maxval=1.0))(keys))[0]
+        logp = np.where(pt > 0, np.log(np.maximum(pt, 1e-30)), -np.inf)
+        commit.append(int(np.argmax(logp - np.log(-np.log(u)))))
+        return a, commit
 
     def _rollback_tail(self, slot, npos):
         """Truncate the slot's block table to the pages covering the

@@ -725,8 +725,8 @@ class TestSpecDecodePaged:
                             page_size=8, min_bucket=8)
         spec = self._spec_engine(params)
         spec._spec._propose_device = \
-            lambda forced, n_forced, start: np.full(
-                (spec.max_slots, spec.spec_tokens), bad, np.int32)
+            lambda forced, n_forced, start, sample=False: (np.full(
+                (spec.max_slots, spec.spec_tokens), bad, np.int32), None)
 
         def state(eng):
             return (tuple(tuple(row) for row in eng._bt),

@@ -401,3 +401,107 @@ def test_gpt_tied_pipeline_parity_and_training():
 
     losses = [float(eng.train_batch([ids], [labels])) for _ in range(6)]
     assert losses[-1] < losses[0], losses
+
+
+# --------------------------------------------------------------------------
+# Non-finite step guard (skip-don't-die)
+# --------------------------------------------------------------------------
+
+def test_nonfinite_guard_coercion_and_policy():
+    """as_guard coercions + the record() skip budget, engine-free."""
+    from paddle_tpu.distributed.nonfinite_guard import (
+        NonFiniteError, NonFiniteGuard, as_guard)
+
+    assert as_guard(None) is None
+    g = NonFiniteGuard(max_consecutive=7)
+    assert as_guard(g) is g
+    assert as_guard(True).max_consecutive == NonFiniteGuard().max_consecutive
+    assert as_guard(5).max_consecutive == 5
+    with pytest.raises(TypeError):
+        as_guard("always")
+    with pytest.raises(ValueError):
+        NonFiniteGuard(max_consecutive=0)
+
+    g = NonFiniteGuard(max_consecutive=2)
+    assert g.record(False) is False
+    assert g.record(True) is True           # 1 consecutive: forgiven
+    assert g.record(False) is False         # clean step resets the streak
+    assert g.record(True) is True
+    with pytest.raises(NonFiniteError):
+        g.record(True)                      # 2 in a row: escalate
+    assert g.skipped_total == 3 and g.steps == 5
+
+
+def test_guard_update_selects_identity_on_nonfinite():
+    """Traced select: finite -> fresh update, NaN/inf anywhere in loss or
+    grads -> bit-identical inputs + skipped flag."""
+    from paddle_tpu.distributed.nonfinite_guard import guard_update
+
+    params = {"w": np.ones(3, np.float32)}
+    opt = {"m": np.zeros(3, np.float32), "step": np.int32(4)}
+    new_p = {"w": np.full(3, 2.0, np.float32)}
+    new_o = {"m": np.full(3, 0.5, np.float32), "step": np.int32(5)}
+    step = jax.jit(guard_update)
+
+    p, o, skipped = step(np.float32(1.0), {"g": np.ones(3, np.float32)},
+                         new_p, new_o, params, opt)
+    assert not bool(skipped)
+    np.testing.assert_array_equal(np.asarray(p["w"]), new_p["w"])
+    assert int(o["step"]) == 5
+
+    for bad_loss, bad_grad in [(np.float32("nan"), 1.0),
+                               (np.float32(1.0), np.float32("inf"))]:
+        p, o, skipped = step(bad_loss,
+                             {"g": np.full(3, bad_grad, np.float32)},
+                             new_p, new_o, params, opt)
+        assert bool(skipped)
+        np.testing.assert_array_equal(np.asarray(p["w"]), params["w"])
+        np.testing.assert_array_equal(np.asarray(o["m"]), opt["m"])
+        assert int(o["step"]) == 4          # Adam's clock did not tick
+
+
+def test_pipeline_nonfinite_guard_end_to_end():
+    """A poisoned step through the REAL compiled pp=2 train step is an
+    exact identity update (params + every optimizer slot bit-identical),
+    and the consecutive-skip budget escalates to NonFiniteError."""
+    from paddle_tpu.distributed.nonfinite_guard import NonFiniteError
+
+    dp, pp, mp = 1, 2, 1
+    cfg, pipe, ids, labels = _bert_setup(pp, mp, dp)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=pipe.parameters())
+    eng = PipelineEngine(pipe, optimizer=opt, dp=dp, pp=pp, mp=mp,
+                         micro_batches=2, nonfinite_guard=2)
+
+    loss = float(eng.train_batch([ids], [labels]))
+    assert np.isfinite(loss)
+    assert eng.nonfinite_guard.skipped_total == 0
+
+    # poison ONE weight -> NaN loss/grads -> the guard must skip
+    params, opt_state = eng.state
+    name = next(k for k, v in params.items()
+                if np.asarray(v).dtype == np.float32)
+    bad = np.asarray(params[name]).copy()
+    bad.flat[0] = np.nan
+    params[name] = jax.numpy.asarray(bad)
+    snap_p = {k: np.asarray(v).copy() for k, v in params.items()}
+    snap_o = jax.tree_util.tree_map(lambda a: np.asarray(a).copy(),
+                                    opt_state)
+
+    loss = eng.train_batch([ids], [labels])
+    assert not np.isfinite(float(loss))     # honest NaN, not rewritten
+    assert eng.nonfinite_guard.skipped_total == 1
+    params, opt_state = eng.state
+    for k, v in snap_p.items():
+        np.testing.assert_array_equal(np.asarray(params[k]), v,
+                                      err_msg=f"param {k} changed")
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), b),
+        opt_state, snap_o)
+
+    # second consecutive poisoned step exhausts the budget of 2
+    with pytest.raises(NonFiniteError):
+        eng.train_batch([ids], [labels])
+    # state was committed before the escalation — still live and intact
+    params, _ = eng.state
+    np.testing.assert_array_equal(np.asarray(params[name]), snap_p[name])

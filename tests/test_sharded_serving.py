@@ -13,8 +13,13 @@ Key properties under test:
     hits), decode steps interleave between chunks, and short prompts
     bypass queued longs while a stream is in flight (anti-convoy);
   - SPECULATIVE DECODING: draft-propose + batched-verify emits exactly
-    the target's greedy sequence (EOS/length retire mid-window included),
-    acceptance counters fill, sampling requests are rejected;
+    the target's greedy sequence (EOS/length retire mid-window included)
+    and acceptance counters fill; SAMPLED requests speculate too, via
+    Leviathan/Chen rejection sampling (accept draft token w.p.
+    min(1, p_target/p_draft), resample the first rejection from the
+    normalized positive residual) — seeded-reproducible, greedy rows in
+    the same batch stay bit-exact, and a disagreeing draft exercises
+    the resample branch;
   - SAMPLER: top-k composes with temperature/top-p, top_k=1 is greedy,
     per-request seeds make a request's tokens deterministic and
     independent of its batch-mates (the engine shares generate(seeds=)'s
@@ -441,10 +446,69 @@ class TestSpeculativeDecoding:
             np.testing.assert_array_equal(np.asarray(r.token_ids), upto(s))
         assert spec_engine.slots.free_count == spec_engine.max_slots
 
-    def test_sampling_rejected_on_spec_engine(self, params, spec_engine):
-        (p,) = _prompts([4], seed=21)
-        with pytest.raises(ValueError, match="greedy"):
-            spec_engine.submit(Request(p, 4, temperature=0.7))
+    def test_sampled_request_speculates(self, params):
+        """A sampling request no longer bounces off a spec engine: the
+        round runs rejection-sampling acceptance. With draft == target
+        the acceptance ratio is min(1, p/p) = 1, so drafts are accepted
+        (up to last-ulp logit drift between the stripe and paged
+        forwards) and the request completes through spec rounds."""
+        eng = PagedEngine(params, ARGS, max_slots=2, max_len=64,
+                          page_size=8, min_bucket=8, draft_params=params,
+                          draft_args=ARGS, spec_tokens=3)
+        (p,) = _prompts([6], seed=21)
+        (req,) = eng.serve([Request(p, 8, temperature=0.7, seed=5)])
+        assert req.finished and len(req.token_ids) == 8
+        c = eng.metrics.summary()["counters"]
+        assert c["spec_rounds"] > 0
+        assert c["draft_tokens_accepted"] > 0
+
+    def test_sampled_spec_reproducible(self, params):
+        """The accept test and residual resample draw from salted
+        branches of the request's (seed, position) stream — the same
+        seed on a fresh engine reproduces the tokens exactly, a
+        different seed diverges."""
+        def run(seed):
+            dp, da = draft_from_params(params, ARGS, 1)
+            eng = PagedEngine(params, ARGS, max_slots=2, max_len=64,
+                              page_size=8, min_bucket=8, draft_params=dp,
+                              draft_args=da, spec_tokens=3)
+            (p,) = _prompts([5], seed=23)
+            (req,) = eng.serve([Request(p, 10, temperature=0.9, top_p=0.95,
+                                        seed=seed)])
+            return list(req.token_ids)
+
+        assert run(7) == run(7)
+        assert run(7) != run(8)
+
+    def test_greedy_row_bit_exact_in_sampled_spec_batch(self, params,
+                                                        spec_engine):
+        """A greedy request batched with a sampling one keeps exact-match
+        acceptance: its output is bit-identical to sequential greedy even
+        though the round runs the sampled verify program."""
+        gp, sp = _prompts([4, 6], seed=29)
+        ref = _sequential(params, [gp], max_new=6)[0]
+        greedy, sampled = spec_engine.serve(
+            [Request(gp, 6), Request(sp, 6, temperature=0.8, seed=3)])
+        np.testing.assert_array_equal(np.asarray(greedy.token_ids), ref)
+        assert sampled.finished and len(sampled.token_ids) == 6
+
+    def test_disagreeing_draft_hits_resample_branch(self, params):
+        """A 1-layer truncated draft disagrees with the target often
+        enough that some accept tests fail — the first rejection in a
+        window must commit a residual-resampled token and bump
+        `spec_resamples` (the branch an always-agreeing draft never
+        takes)."""
+        dp, da = draft_from_params(params, ARGS, 1)
+        eng = PagedEngine(params, ARGS, max_slots=2, max_len=64,
+                          page_size=8, min_bucket=8, draft_params=dp,
+                          draft_args=da, spec_tokens=3)
+        prompts = _prompts([4, 7], seed=31)
+        reqs = eng.serve([Request(p, 12, temperature=1.0, seed=s)
+                          for s, p in enumerate(prompts)])
+        assert all(r.finished for r in reqs)
+        c = eng.metrics.summary()["counters"]
+        assert c["spec_resamples"] > 0
+        assert c["draft_tokens_accepted"] < c["draft_tokens_proposed"]
 
     # the worst-case all-rejected rollback test (block tables +
     # refcounts bit-identical to plain decode after every round)

@@ -7,10 +7,11 @@ Layout mirrors paddle_tpu/analysis:
     hand-built programs, including a seeded violation per rule (inject
     an f32 matmul under bf16, drop a donation, double a psum, add a
     pure_callback — each must be flagged WITH provenance);
-  - TestProgramFamilies: presets.run_cpu_audits over the four real
+  - TestProgramFamilies: presets.run_cpu_audits over the five real
     families (hybrid train step, PagedEngine prefill/decode/verify/
-    page-copy, fused-CE fwd+bwd, fused optimizer write-back) must be
-    clean — this is the CI invariant gate;
+    page-copy, fused-CE fwd+bwd, fused optimizer write-back, disagg
+    migration + router GPT) must be clean — this is the CI invariant
+    gate;
   - TestFrameworkLint: the AST lint on a seeded violation tree + the
     allowlist mechanics + the repo itself linting clean;
   - TestXprofGates: tools/xprof_report.py --json/--min-busy-pct exit
@@ -407,6 +408,32 @@ class TestProgramFamilies:
             census = collective_audit.collective_census(progs[name].jaxpr)
             assert [c["prim"] for c in census] == ["psum", "psum"], name
             assert all(c["axes"] == ("mp",) for c in census), name
+
+    def test_disagg_family_clean(self):
+        assert presets.audit_disagg() == []
+
+    def test_disagg_captured_all_programs(self):
+        progs = programs.disagg_programs()
+        assert set(presets.GOLDEN_DISAGG) <= set(progs), \
+            "a disagg program family stopped being captured"
+
+    def test_disagg_migration_is_pure_data_movement(self):
+        # a collective creeping into extract/scatter would put a
+        # cross-shard hop on every hand-off — the census must stay empty
+        progs = programs.disagg_programs()
+        for name in ("page_extract", "page_scatter",
+                     "page_extract_int8", "page_scatter_int8"):
+            assert collective_audit.collective_census(
+                progs[name].jaxpr) == [], name
+
+    def test_missing_disagg_program_is_reported_not_silent(self,
+                                                           monkeypatch):
+        real = programs.disagg_programs()
+        pruned = {k: v for k, v in real.items() if k != "page_scatter"}
+        monkeypatch.setattr(programs, "disagg_programs", lambda: pruned)
+        v = presets.audit_disagg()
+        assert any(x.rule == "audit.program-not-captured"
+                   and x.program == "page_scatter" for x in v)
 
     def test_missing_family_is_reported_not_silent(self, monkeypatch):
         real = programs.serving_programs(tp=2)

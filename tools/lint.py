@@ -24,7 +24,8 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-ALL_FAMILIES = ("fused_ce", "train_step", "opt_writeback", "serving")
+ALL_FAMILIES = ("fused_ce", "train_step", "opt_writeback", "serving",
+                "disagg")
 
 
 def run_ast_lint():
